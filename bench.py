@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Benchmark harness — prints ONE JSON line with the headline metric.
+
+Headline metric (BASELINE.md): ImageNet samples/sec/chip on ResNet-50
+training (fwd+bwd+update, bf16 mixed precision, synthetic data so the loader
+can't be the bottleneck). Falls back down the model ladder if a family isn't
+built yet.
+
+``vs_baseline``: BASELINE.json's ``published`` is empty (reference repo
+absent — see BASELINE.md); the comparison constant below is the documented
+*assumed* A100-DDP ResNet-50 figure (2500 samples/sec/chip, bf16) so the
+ratio is meaningful the day real numbers surface. Target from the north
+star: >= 0.9 * A100 -> vs_baseline >= 0.9.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+# Assumed reference numbers (documented stand-ins; see module docstring).
+ASSUMED_BASELINE = {
+    "rn50_imagenet_samples_per_sec_per_chip": 2500.0,
+    "mnist_mlp_samples_per_sec_per_chip": 100000.0,
+}
+
+
+def bench_config(name: str, overrides: list[str], *, steps: int, warmup: int):
+    from frl_distributed_ml_scaffold_tpu.config import apply_overrides, get_config
+    from frl_distributed_ml_scaffold_tpu.trainer.loop import Trainer
+    from frl_distributed_ml_scaffold_tpu.utils.timing import StepTimer
+
+    cfg = apply_overrides(get_config(name), overrides)
+    trainer = Trainer(cfg)
+    state = trainer.init_state()
+    timer = StepTimer(warmup=warmup)
+    for step in range(steps + warmup + 1):
+        batch = trainer.pipeline.global_batch(step)
+        state, metrics = trainer.train_step(state, batch)
+        timer.tick(metrics["loss"])
+    return timer.summary(cfg.data.global_batch_size)
+
+
+def main() -> int:
+    candidates = [
+        (
+            "rn50_imagenet_samples_per_sec_per_chip",
+            "imagenet_rn50_ddp",
+            ["data.global_batch_size=256", "trainer.log_every=1000000"],
+            20,
+        ),
+        (
+            "mnist_mlp_samples_per_sec_per_chip",
+            "mnist_mlp",
+            ["data.global_batch_size=1024", "trainer.log_every=1000000"],
+            50,
+        ),
+    ]
+    last_err = None
+    for metric, cfg_name, overrides, steps in candidates:
+        try:
+            perf = bench_config(cfg_name, overrides, steps=steps, warmup=3)
+            value = perf["samples_per_sec_per_chip"]
+            base = ASSUMED_BASELINE[metric]
+            print(
+                json.dumps(
+                    {
+                        "metric": metric,
+                        "value": round(value, 2),
+                        "unit": "samples/sec/chip",
+                        "vs_baseline": round(value / base, 4),
+                    }
+                )
+            )
+            return 0
+        except Exception as e:  # fall down the ladder, report at the end
+            last_err = e
+            continue
+    print(json.dumps({"metric": "error", "value": 0, "unit": "", "vs_baseline": 0,
+                      "error": str(last_err)}))
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

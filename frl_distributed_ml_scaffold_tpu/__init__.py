@@ -1,0 +1,31 @@
+"""frl_distributed_ml_scaffold_tpu — a TPU-native distributed-ML scaffold.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of
+facebookresearch/FRL-Distributed-ML-Scaffold (see SURVEY.md — the reference
+mount is empty in this environment, so parity targets are the reconstructed
+component inventory SURVEY.md §2, C1–C20, and the five BASELINE.json configs).
+
+Architecture (TPU-first, not a torch translation):
+
+- ``dist/``      — device mesh topology + a thin collective façade over XLA
+                   collectives (ICI/DCN), replacing the reference's
+                   NCCL/Gloo process groups (SURVEY C1, C2).
+- ``trainer/``   — a single jit-compiled train step (grad-accum via
+                   ``lax.scan``, remat via ``jax.checkpoint``, bf16 precision
+                   policy) replacing the DDP/FSDP wrapper + autocast step
+                   loop (SURVEY C3, C10–C12).
+- ``parallel/``  — parallelism as sharding annotations: DP/FSDP/ZeRO/TP/PP/
+                   SP(ring+Ulysses)/EP as PartitionSpec rules over one mesh
+                   (SURVEY C4–C9).
+- ``models/``    — MLP, ResNet-50, ViT-B/16, GPT-2-medium, video classifier
+                   (SURVEY C15).
+- ``data/``      — per-host sharded input pipelines (SURVEY C16).
+- ``checkpoint/``— Orbax sharded save/restore with topology-changed resume
+                   (SURVEY C13).
+- ``launcher/``  — single-entrypoint CLI + elastic checkpoint-restart
+                   supervisor (SURVEY C1, C14).
+- ``ops/``       — Pallas TPU kernels (ring/flash attention) and fused ops.
+- ``utils/``     — pytree paths, logging, timers, profiling (SURVEY C18, C19).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,10 @@
+"""Sharded checkpointing (SURVEY C13): Orbax save/restore with resharding.
+
+Reference behavior: rank-coordinated sharded state-dict files + metadata,
+reload + reshard on resume. TPU-native: Orbax ``CheckpointManager`` — async
+save off the training thread, restore driven by an *abstract* state pytree
+carrying NamedShardings, so a checkpoint written on one topology restores
+onto another (the elastic-resume path, SURVEY C14).
+"""
+
+from frl_distributed_ml_scaffold_tpu.checkpoint.manager import Checkpointer

@@ -1,0 +1,72 @@
+"""Orbax-backed checkpoint manager (SURVEY C13, call stack (c))."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import orbax.checkpoint as ocp
+
+from frl_distributed_ml_scaffold_tpu.config.schema import CheckpointConfig
+from frl_distributed_ml_scaffold_tpu.trainer.train_state import TrainState
+from frl_distributed_ml_scaffold_tpu.utils.logging import get_logger
+
+
+class Checkpointer:
+    """Async sharded save + resharding restore for a TrainState.
+
+    ``restore_or_init(trainer)`` is the one entry the Trainer and the elastic
+    supervisor both use: if a checkpoint exists it restores **into the
+    trainer's current shardings** (which may correspond to a different
+    topology than the writer's — Orbax reshards from the abstract target
+    pytree); otherwise it initializes fresh.
+    """
+
+    def __init__(self, directory: str, cfg: CheckpointConfig):
+        self.directory = directory
+        self.cfg = cfg
+        self.logger = get_logger()
+        self._mngr = ocp.CheckpointManager(
+            directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=cfg.max_to_keep,
+                enable_async_checkpointing=cfg.async_save,
+            ),
+        )
+
+    def save(self, step: int, state: TrainState, *, force: bool = False) -> bool:
+        saved = self._mngr.save(
+            step, args=ocp.args.StandardSave(state), force=force
+        )
+        if saved:
+            self.logger.info("checkpoint saved at step %d -> %s", step, self.directory)
+        return saved
+
+    def latest_step(self) -> int | None:
+        return self._mngr.latest_step()
+
+    def restore(self, state_shapes: Any, state_shardings: Any, step: int | None = None):
+        """Restore into the given shardings (resharding as needed)."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            return None
+        abstract = jax.tree.map(
+            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+            state_shapes,
+            state_shardings,
+        )
+        restored = self._mngr.restore(step, args=ocp.args.StandardRestore(abstract))
+        self.logger.info("restored checkpoint step %d from %s", step, self.directory)
+        return restored
+
+    def restore_or_init(self, trainer) -> TrainState:
+        step = self.latest_step()
+        if step is not None:
+            return self.restore(trainer.state_shapes, trainer.state_shardings, step)
+        return trainer.init_state()
+
+    def wait(self) -> None:
+        self._mngr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mngr.close()

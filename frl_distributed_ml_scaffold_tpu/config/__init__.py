@@ -1,0 +1,34 @@
+"""Config system (SURVEY C17): typed dataclass tree + dotted-path overrides.
+
+The reference scaffold selects a per-recipe config and lets the CLI override
+fields; we reproduce that with plain dataclasses (ml_collections is not in
+this image) — every field is typed, every override is validated against the
+schema, and configs serialize to JSON for run records.
+"""
+
+from frl_distributed_ml_scaffold_tpu.config.core import (
+    apply_overrides,
+    config_to_dict,
+    config_from_dict,
+    pretty_config,
+)
+from frl_distributed_ml_scaffold_tpu.config.schema import (
+    CheckpointConfig,
+    DataConfig,
+    ExperimentConfig,
+    GPTConfig,
+    MLPConfig,
+    MeshConfig,
+    MoEConfig,
+    OptimizerConfig,
+    PrecisionConfig,
+    ResNetConfig,
+    TrainerConfig,
+    VideoConfig,
+    ViTConfig,
+)
+from frl_distributed_ml_scaffold_tpu.config.registry import (
+    get_config,
+    list_configs,
+    register_config,
+)

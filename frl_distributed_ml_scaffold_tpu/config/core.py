@@ -1,0 +1,170 @@
+"""Dataclass config machinery: dotted-path overrides, (de)serialization.
+
+``apply_overrides(cfg, ["trainer.lr=3e-4", "mesh.data=8"])`` returns a new
+config with those fields replaced, type-coerced against the dataclass schema.
+Unknown paths and un-coercible values raise — silent config typos are how
+training runs die at step 80k.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Optional, Union, get_args, get_origin
+
+
+def _coerce(raw: str, typ: Any) -> Any:
+    """Parse a CLI string into the target annotation type."""
+    origin = get_origin(typ)
+    if origin is Union:  # Optional[X] and unions
+        args = [a for a in get_args(typ) if a is not type(None)]
+        if raw.lower() in ("none", "null"):
+            return None
+        last_err: Exception | None = None
+        for a in args:
+            try:
+                return _coerce(raw, a)
+            except (ValueError, TypeError) as e:
+                last_err = e
+        raise ValueError(f"cannot parse {raw!r} as {typ}: {last_err}")
+    if origin in (tuple, list):
+        inner = get_args(typ)
+        items = [s for s in raw.strip("()[]").split(",") if s.strip()]
+        if origin is tuple and inner and inner[-1] is not Ellipsis:
+            coerced = [_coerce(s.strip(), t) for s, t in zip(items, inner)]
+            return tuple(coerced)
+        elem_t = inner[0] if inner else str
+        coerced = [_coerce(s.strip(), elem_t) for s in items]
+        return tuple(coerced) if origin is tuple else coerced
+    if origin is dict:
+        return json.loads(raw)
+    if typ is bool:
+        if raw.lower() in ("true", "1", "yes"):
+            return True
+        if raw.lower() in ("false", "0", "no"):
+            return False
+        raise ValueError(f"cannot parse {raw!r} as bool")
+    if typ is int:
+        return int(raw)
+    if typ is float:
+        return float(raw)
+    if typ is str:
+        return raw
+    if typ is Any:
+        try:
+            return json.loads(raw)
+        except json.JSONDecodeError:
+            return raw
+    if dataclasses.is_dataclass(typ):
+        return config_from_dict(typ, json.loads(raw))
+    raise TypeError(f"unsupported config field type {typ} for value {raw!r}")
+
+
+def _field_type(cfg: Any, name: str) -> Any:
+    for f in dataclasses.fields(cfg):
+        if f.name == name:
+            return f.type if not isinstance(f.type, str) else _resolve_str_type(cfg, f.type)
+    raise KeyError(
+        f"{type(cfg).__name__} has no field {name!r} "
+        f"(fields: {[f.name for f in dataclasses.fields(cfg)]})"
+    )
+
+
+def _resolve_str_type(cfg: Any, ann: str) -> Any:
+    """Resolve string annotations (from __future__ annotations)."""
+    import sys
+    import typing
+
+    mod = sys.modules.get(type(cfg).__module__)
+    ns = dict(vars(typing))
+    if mod is not None:
+        ns.update(vars(mod))
+    return eval(ann, ns)  # noqa: S307 — schema-controlled input
+
+
+def apply_overrides(cfg: Any, overrides: list[str]) -> Any:
+    """Apply ``"a.b.c=value"`` overrides, returning a new config."""
+    for ov in overrides:
+        if "=" not in ov:
+            raise ValueError(f"override {ov!r} must look like path.to.field=value")
+        path, raw = ov.split("=", 1)
+        cfg = _set_path(cfg, path.strip().lstrip("-").split("."), raw.strip())
+    return cfg
+
+
+def _set_path(cfg: Any, parts: list[str], raw: str) -> Any:
+    if not dataclasses.is_dataclass(cfg):
+        raise TypeError(f"cannot descend into non-dataclass {type(cfg)} at {parts}")
+    head, rest = parts[0], parts[1:]
+    if rest:
+        child = getattr(cfg, head)
+        if child is None:
+            raise ValueError(f"cannot override field of None sub-config {head!r}")
+        new_child = _set_path(child, rest, raw)
+        return dataclasses.replace(cfg, **{head: new_child})
+    typ = _field_type(cfg, head)
+    return dataclasses.replace(cfg, **{head: _coerce(raw, typ)})
+
+
+def config_to_dict(cfg: Any) -> Any:
+    """Recursive dataclass → plain-dict conversion (JSON-safe)."""
+    if dataclasses.is_dataclass(cfg):
+        return {f.name: config_to_dict(getattr(cfg, f.name)) for f in dataclasses.fields(cfg)}
+    if isinstance(cfg, (list, tuple)):
+        return [config_to_dict(x) for x in cfg]
+    if isinstance(cfg, dict):
+        return {k: config_to_dict(v) for k, v in cfg.items()}
+    return cfg
+
+
+def config_from_dict(typ: Any, data: dict) -> Any:
+    """Inverse of config_to_dict for a known dataclass type."""
+    if not dataclasses.is_dataclass(typ):
+        return data
+    import typing
+
+    hints = typing.get_type_hints(typ)
+    kwargs = {}
+    for f in dataclasses.fields(typ):
+        if f.name not in data:
+            continue
+        v = data[f.name]
+        ft = hints.get(f.name)
+        if ft is not None and dataclasses.is_dataclass(ft) and isinstance(v, dict):
+            kwargs[f.name] = config_from_dict(ft, v)
+        elif (
+            ft in (Any, None)
+            and isinstance(v, dict)
+            and "family" in v
+        ):
+            # Polymorphic model field: dispatch on the `family` tag.
+            kwargs[f.name] = _model_config_from_dict(v)
+        elif isinstance(v, list):
+            kwargs[f.name] = tuple(v) if _is_tuple_field(typ, f) else v
+        else:
+            kwargs[f.name] = v
+    return typ(**kwargs)
+
+
+def _model_config_from_dict(v: dict) -> Any:
+    from frl_distributed_ml_scaffold_tpu.config import schema
+
+    families = {
+        "mlp": schema.MLPConfig,
+        "resnet": schema.ResNetConfig,
+        "vit": schema.ViTConfig,
+        "gpt": schema.GPTConfig,
+        "video": schema.VideoConfig,
+    }
+    return config_from_dict(families[v["family"]], v)
+
+
+def _is_tuple_field(typ: Any, f: dataclasses.Field) -> bool:
+    ann = f.type
+    if isinstance(ann, str):
+        return ann.startswith(("tuple", "Tuple"))
+    return get_origin(ann) is tuple
+
+
+def pretty_config(cfg: Any) -> str:
+    return json.dumps(config_to_dict(cfg), indent=2, default=str)

@@ -1,0 +1,43 @@
+"""Named-config registry: ``get_config("imagenet_rn50_ddp")`` → ExperimentConfig.
+
+Mirrors the reference scaffold's per-recipe config selection. Recipes register
+themselves at import; config/recipes.py holds the five BASELINE.json
+acceptance configs.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from frl_distributed_ml_scaffold_tpu.config.schema import ExperimentConfig
+
+_REGISTRY: dict[str, Callable[[], ExperimentConfig]] = {}
+
+
+def register_config(name: str):
+    """Decorator: register a zero-arg builder returning an ExperimentConfig."""
+
+    def deco(fn: Callable[[], ExperimentConfig]):
+        if name in _REGISTRY:
+            raise ValueError(f"config {name!r} already registered")
+        _REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+def get_config(name: str) -> ExperimentConfig:
+    _ensure_recipes_loaded()
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown config {name!r}; available: {sorted(_REGISTRY)}")
+    return _REGISTRY[name]()
+
+
+def list_configs() -> list[str]:
+    _ensure_recipes_loaded()
+    return sorted(_REGISTRY)
+
+
+def _ensure_recipes_loaded() -> None:
+    # Import side effect registers the built-in recipes exactly once.
+    from frl_distributed_ml_scaffold_tpu.config import recipes  # noqa: F401

@@ -1,0 +1,250 @@
+"""Config schema: every knob the framework exposes, as typed dataclasses.
+
+One ``ExperimentConfig`` fully describes a run — model, data, mesh,
+parallelism strategy, precision, optimizer, checkpointing. The five
+BASELINE.json reference recipes are instances of this schema
+(config/recipes.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+# --------------------------------------------------------------------------
+# Mesh / parallelism
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Logical device-mesh shape (SURVEY C2).
+
+    Axis sizes multiply to the device count; ``data = -1`` means "absorb all
+    remaining devices". Axes of size 1 are still present in the mesh so
+    PartitionSpecs can always name them — XLA drops trivial dimensions at
+    compile time.
+
+    The axis vocabulary is the whole parallelism story (SURVEY C4–C9):
+
+    - ``data``:   DP — batch sharded, params replicated (or FSDP-sharded).
+    - ``fsdp``:   parameter/optimizer sharding axis (FSDP/ZeRO). Kept
+                  separate from ``data`` so DP×FSDP hybrids express naturally.
+    - ``model``:  tensor parallelism (Megatron column/row splits).
+    - ``seq``:    sequence/context parallelism (ring attention, Ulysses).
+    - ``expert``: MoE expert parallelism.
+    - ``pipe``:   pipeline stages.
+    """
+
+    data: int = -1
+    fsdp: int = 1
+    model: int = 1
+    seq: int = 1
+    expert: int = 1
+    pipe: int = 1
+    # Number of DCN (cross-slice) segments along the data axis; 1 = single
+    # slice. When >1, the mesh is built hybrid: data axis spans DCN, all other
+    # axes stay inside the ICI slice.
+    dcn_data: int = 1
+
+    def axis_sizes(self) -> dict[str, int]:
+        return {
+            "data": self.data,
+            "fsdp": self.fsdp,
+            "model": self.model,
+            "seq": self.seq,
+            "expert": self.expert,
+            "pipe": self.pipe,
+        }
+
+
+@dataclass(frozen=True)
+class ParallelConfig:
+    """How state is laid out over the mesh (SURVEY C4–C9).
+
+    - ``param_sharding``: "replicated" (DDP) or "fsdp" (full shard over the
+      fsdp axis — SimpleFSDP-style sharding annotations, no wrapper module).
+    - ``opt_sharding``: "like_params" | "zero1" (shard optimizer state over
+      the fsdp axis even when params are replicated — ZeRO-1).
+    - ``sequence``: "none" | "ring" | "ulysses" — long-context attention mode.
+    - ``fsdp_min_size``: leaves smaller than this stay replicated (sharding
+      tiny params costs more collective latency than it saves memory).
+    """
+
+    param_sharding: str = "replicated"  # replicated | fsdp
+    opt_sharding: str = "like_params"  # like_params | zero1
+    sequence: str = "none"  # none | ring | ulysses
+    fsdp_min_size: int = 1024
+    pipeline_microbatches: int = 1
+
+
+@dataclass(frozen=True)
+class PrecisionConfig:
+    """Mixed-precision policy (SURVEY C10).
+
+    bf16 on TPU needs no loss scaling (8-bit exponent), so the reference's
+    GradScaler has no equivalent here — ``bf16_mixed`` keeps fp32 master
+    params with bf16 compute, matching "bf16 AMP" semantics.
+    """
+
+    policy: str = "bf16_mixed"  # fp32 | bf16 | bf16_mixed
+
+
+# --------------------------------------------------------------------------
+# Trainer / optimizer / checkpoint / data
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class OptimizerConfig:
+    name: str = "adamw"  # adamw | sgd | adam
+    learning_rate: float = 1e-3
+    warmup_steps: int = 0
+    schedule: str = "constant"  # constant | cosine | linear
+    weight_decay: float = 0.0
+    b1: float = 0.9
+    b2: float = 0.999
+    eps: float = 1e-8
+    momentum: float = 0.9  # sgd only
+    grad_clip_norm: Optional[float] = None
+
+
+@dataclass(frozen=True)
+class TrainerConfig:
+    total_steps: int = 1000
+    grad_accum: int = 1
+    remat: str = "none"  # none | full | dots
+    log_every: int = 50
+    eval_every: int = 0  # 0 = no eval during training
+    eval_steps: int = 10
+    seed: int = 0
+
+
+@dataclass(frozen=True)
+class CheckpointConfig:
+    enabled: bool = False
+    save_every: int = 1000
+    max_to_keep: int = 3
+    async_save: bool = True
+    resume: bool = True  # restore latest checkpoint if present
+
+
+@dataclass(frozen=True)
+class DataConfig:
+    """Input pipeline selection (SURVEY C16). ``global_batch_size`` is the
+    whole-run batch; the pipeline shards it per host and the mesh shards it
+    per chip."""
+
+    name: str = "synthetic_mnist"
+    global_batch_size: int = 128
+    image_size: int = 28
+    num_classes: int = 10
+    channels: int = 1
+    seq_len: int = 1024
+    vocab_size: int = 50257
+    num_frames: int = 8
+    shuffle_seed: int = 0
+    # For real datasets: directory to look in; synthetic fallback if absent.
+    data_dir: Optional[str] = None
+
+
+# --------------------------------------------------------------------------
+# Model families (SURVEY C15)
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class MLPConfig:
+    family: str = "mlp"
+    hidden_sizes: tuple[int, ...] = (512, 256)
+    num_classes: int = 10
+    dropout: float = 0.0
+
+
+@dataclass(frozen=True)
+class ResNetConfig:
+    family: str = "resnet"
+    depth: int = 50  # 18 | 34 | 50 | 101 | 152
+    num_classes: int = 1000
+    width_multiplier: int = 1
+
+
+@dataclass(frozen=True)
+class ViTConfig:
+    family: str = "vit"
+    image_size: int = 224
+    patch_size: int = 16
+    hidden_dim: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_ratio: int = 4
+    num_classes: int = 1000
+    dropout: float = 0.0
+    pool: str = "cls"  # cls | mean
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    """Mixture-of-experts block config (SURVEY C9). ``num_experts = 0``
+    disables MoE."""
+
+    num_experts: int = 0
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    router_aux_loss: float = 0.01
+
+
+@dataclass(frozen=True)
+class GPTConfig:
+    family: str = "gpt"
+    vocab_size: int = 50257
+    num_layers: int = 24
+    num_heads: int = 16
+    hidden_dim: int = 1024
+    seq_len: int = 1024
+    mlp_ratio: int = 4
+    dropout: float = 0.0
+    # Attention implementation: "dense" | "ring" | "ulysses" | "flash"
+    attention: str = "dense"
+    moe: MoEConfig = field(default_factory=MoEConfig)
+
+
+@dataclass(frozen=True)
+class VideoConfig:
+    """Video-clip classifier (BASELINE config 5): ViT over tubelet embeddings
+    of a frame stack — the TPU-native stand-in for the Ego4D recipe."""
+
+    family: str = "video"
+    image_size: int = 224
+    num_frames: int = 8
+    tubelet_size: tuple[int, int, int] = (2, 16, 16)  # (t, h, w)
+    hidden_dim: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_ratio: int = 4
+    num_classes: int = 400
+    dropout: float = 0.0
+
+
+# --------------------------------------------------------------------------
+# Top-level experiment
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ExperimentConfig:
+    name: str = "experiment"
+    model: Any = field(default_factory=MLPConfig)
+    data: DataConfig = field(default_factory=DataConfig)
+    trainer: TrainerConfig = field(default_factory=TrainerConfig)
+    optimizer: OptimizerConfig = field(default_factory=OptimizerConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    parallel: ParallelConfig = field(default_factory=ParallelConfig)
+    precision: PrecisionConfig = field(default_factory=PrecisionConfig)
+    checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    workdir: str = "/tmp/frl_tpu_runs"
+
+    def replace(self, **kw) -> "ExperimentConfig":
+        return dataclasses.replace(self, **kw)

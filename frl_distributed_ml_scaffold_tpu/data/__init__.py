@@ -1,0 +1,19 @@
+"""Input pipelines (SURVEY C16): per-host sharded loaders.
+
+Reference: per-rank DataLoader shards. TPU-native: each *process* produces
+its local slice of the global batch as numpy; the trainer assembles the
+global sharded ``jax.Array`` with ``make_array_from_process_local_data`` so
+no batch element ever crosses hosts.
+
+Real-dataset loaders (MNIST/ImageNet/LM/video) check ``data_dir`` and fall
+back to deterministic *learnable* synthetic data (class-prototype images,
+rule-generated token streams) when absent — this zero-egress environment has
+no datasets, and smoke/acceptance tests need losses that actually decrease
+(SURVEY §4 integration tier).
+"""
+
+from frl_distributed_ml_scaffold_tpu.data.pipeline import (
+    Batch,
+    DataPipeline,
+    build_pipeline,
+)

@@ -1,0 +1,27 @@
+"""Distributed layer (SURVEY C1, C2): the TPU-native ``dist/`` equivalent.
+
+The reference's ``dist/`` wraps NCCL/Gloo process groups and explicit
+collective calls. On TPU the transport is ICI (intra-slice torus) / DCN
+(cross-slice), and collectives are either compiler-inserted by GSPMD or
+explicit ``lax`` primitives inside ``shard_map``. This package is the thin
+façade so no user code ever touches backend specifics:
+
+- ``initialize.py`` — process bring-up (``jax.distributed.initialize``),
+  the single cross-host control point (replaces torchrun rendezvous).
+- ``mesh.py``       — logical mesh construction over the physical topology,
+  including hybrid ICI×DCN meshes.
+- ``collectives.py``— allreduce/allgather/reduce-scatter/broadcast/barrier/
+  ppermute/all_to_all wrappers usable inside jit (shard_map) and host-side.
+"""
+
+from frl_distributed_ml_scaffold_tpu.dist.initialize import (
+    initialize_distributed,
+    process_count,
+    process_index,
+)
+from frl_distributed_ml_scaffold_tpu.dist.mesh import (
+    MeshEnv,
+    build_mesh,
+    local_batch_size,
+)
+from frl_distributed_ml_scaffold_tpu.dist import collectives

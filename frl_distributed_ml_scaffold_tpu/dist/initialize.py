@@ -1,0 +1,69 @@
+"""Process bring-up (SURVEY C1, call stack (a)).
+
+Reference behavior: torchrun spawns N workers per node and each calls
+``dist.init_process_group("nccl")`` with a TCP rendezvous. TPU-native: JAX is
+multi-controller SPMD — ONE process per host, each owning its local chips;
+``jax.distributed.initialize`` is the only cross-host control point. On a
+single host (or under test) initialization is a no-op.
+
+Environment contract (mirrors torchrun's env:// rendezvous, TPU-flavored):
+``FRL_TPU_COORDINATOR`` (host:port), ``FRL_TPU_NUM_PROCESSES``,
+``FRL_TPU_PROCESS_ID`` — all optional; on Cloud TPU pod slices JAX
+auto-detects all three from the metadata server.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+_INITIALIZED = False
+
+
+def initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Bring up multi-host JAX if configured; safe to call unconditionally.
+
+    Resolution order: explicit args > FRL_TPU_* env vars > JAX autodetection
+    (Cloud TPU metadata). Single-process runs skip initialization entirely.
+    """
+    global _INITIALIZED
+    if _INITIALIZED:
+        return
+    coordinator_address = coordinator_address or os.environ.get("FRL_TPU_COORDINATOR")
+    if num_processes is None and "FRL_TPU_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["FRL_TPU_NUM_PROCESSES"])
+    if process_id is None and "FRL_TPU_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["FRL_TPU_PROCESS_ID"])
+
+    if num_processes is not None and num_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        _INITIALIZED = True
+    elif coordinator_address is not None:
+        # Pod-slice autodetect path: let JAX fill in counts from the platform.
+        jax.distributed.initialize(coordinator_address=coordinator_address)
+        _INITIALIZED = True
+    # else: single process — nothing to initialize.
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def shutdown_distributed() -> None:
+    global _INITIALIZED
+    if _INITIALIZED:
+        jax.distributed.shutdown()
+        _INITIALIZED = False

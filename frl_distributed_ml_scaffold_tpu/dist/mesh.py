@@ -1,0 +1,134 @@
+"""Logical device mesh over the physical TPU topology (SURVEY C2, §5).
+
+The reference maps ranks onto NCCL communicators; TPU-native, parallelism is
+one ``jax.sharding.Mesh`` whose axes are the parallelism dimensions
+(data/fsdp/model/seq/expert/pipe — see MeshConfig). Axis placement determines
+which transport the collectives ride: intra-slice axes use ICI (the 2D/3D
+torus), and when ``dcn_data > 1`` the data axis spans DCN via a hybrid mesh —
+laid out so gradient allreduce crosses DCN once while everything else stays
+on ICI.
+
+Batch semantics: FSDP *is* data parallelism with parameters sharded, so the
+global batch dimension shards over ``("data", "fsdp")`` jointly; ``seq``
+additionally shards the sequence dimension for long-context runs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from frl_distributed_ml_scaffold_tpu.config.schema import MeshConfig
+
+# Canonical axis order. Collective-heaviest axes go LAST so
+# mesh_utils places them on the fastest (innermost) physical links:
+# model/seq/expert collectives fire per-layer, data/fsdp once per step.
+AXES: tuple[str, ...] = ("pipe", "data", "fsdp", "seq", "expert", "model")
+
+# Axes that jointly shard the global batch dimension.
+BATCH_AXES: tuple[str, ...] = ("data", "fsdp")
+
+
+@dataclass(frozen=True)
+class MeshEnv:
+    """A resolved mesh + its config; the object the trainer passes around."""
+
+    mesh: Mesh
+    config: MeshConfig
+
+    @property
+    def num_devices(self) -> int:
+        return self.mesh.size
+
+    def axis_size(self, name: str) -> int:
+        return self.mesh.shape[name]
+
+    @property
+    def batch_axis_size(self) -> int:
+        return self.axis_size("data") * self.axis_size("fsdp")
+
+    def batch_spec(self, *trailing) -> P:
+        """PartitionSpec for a batch-leading array: ``P(("data","fsdp"), ...)``."""
+        return P(BATCH_AXES, *trailing)
+
+    def batch_sharding(self, *trailing) -> NamedSharding:
+        return NamedSharding(self.mesh, self.batch_spec(*trailing))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def sharding(self, spec: P) -> NamedSharding:
+        return NamedSharding(self.mesh, spec)
+
+
+def resolve_axis_sizes(cfg: MeshConfig, n_devices: int) -> dict[str, int]:
+    """Fill the ``-1`` wildcard axis and validate the product."""
+    sizes = cfg.axis_sizes()
+    wild = [k for k, v in sizes.items() if v == -1]
+    if len(wild) > 1:
+        raise ValueError(f"at most one mesh axis may be -1, got {wild}")
+    fixed = int(np.prod([v for v in sizes.values() if v != -1]))
+    if wild:
+        if n_devices % fixed != 0:
+            raise ValueError(
+                f"{n_devices} devices not divisible by fixed axes product {fixed}"
+            )
+        sizes[wild[0]] = n_devices // fixed
+    total = int(np.prod(list(sizes.values())))
+    if total != n_devices:
+        raise ValueError(
+            f"mesh {sizes} needs {total} devices but {n_devices} are available"
+        )
+    return sizes
+
+
+def build_mesh(cfg: MeshConfig, devices=None) -> MeshEnv:
+    """Construct the mesh with topology-aware device ordering.
+
+    ``mesh_utils.create_device_mesh`` permutes devices so that mesh-adjacent
+    devices are ICI-adjacent; ``create_hybrid_device_mesh`` additionally
+    keeps DCN-crossing axes outermost for multi-slice (``dcn_data > 1``).
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    sizes = resolve_axis_sizes(cfg, len(devices))
+    shape = tuple(sizes[a] for a in AXES)
+
+    if cfg.dcn_data > 1:
+        if sizes["data"] % cfg.dcn_data != 0:
+            raise ValueError(
+                f"data axis {sizes['data']} not divisible by dcn_data={cfg.dcn_data}"
+            )
+        ici_shape = tuple(
+            sizes[a] // cfg.dcn_data if a == "data" else sizes[a] for a in AXES
+        )
+        dcn_shape = tuple(cfg.dcn_data if a == "data" else 1 for a in AXES)
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            ici_shape, dcn_shape, devices=devices
+        )
+    else:
+        try:
+            dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+        except (ValueError, AssertionError, NotImplementedError):
+            # CPU-sim and odd topologies: plain row-major placement.
+            dev_array = np.asarray(devices).reshape(shape)
+
+    return MeshEnv(mesh=Mesh(dev_array, AXES), config=cfg)
+
+
+def local_batch_size(global_batch_size: int, env: MeshEnv | None = None) -> int:
+    """Per-host batch share (reference: per-rank batch). Validates evenness."""
+    n_proc = jax.process_count()
+    if global_batch_size % n_proc != 0:
+        raise ValueError(
+            f"global batch {global_batch_size} not divisible by {n_proc} processes"
+        )
+    if env is not None and global_batch_size % env.batch_axis_size != 0:
+        raise ValueError(
+            f"global batch {global_batch_size} not divisible by "
+            f"batch mesh axes ({env.batch_axis_size})"
+        )
+    return global_batch_size // n_proc

@@ -1,0 +1,10 @@
+"""Launcher (SURVEY C1, C14): single entrypoint + elastic supervision.
+
+Replaces torchrun: no rank spawning — JAX is multi-controller SPMD, so the
+launcher's job is platform selection (``--device=tpu|cpu``), optional
+multi-host bring-up (``jax.distributed.initialize``), config resolution with
+CLI overrides, and (optionally) supervising the run for checkpoint-restart
+elasticity.
+"""
+
+from frl_distributed_ml_scaffold_tpu.launcher.launch import main, run_experiment

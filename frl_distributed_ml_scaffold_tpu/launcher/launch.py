@@ -1,0 +1,119 @@
+"""``launch.py`` — the torchrun-equivalent entrypoint (SURVEY C1).
+
+Usage::
+
+    python launch.py --config=mnist_mlp [--device=tpu|cpu] [--sim-devices=N]
+                     [--list-configs] [--elastic] [path.to.field=value ...]
+
+Reference stack (a): torchrun forks N workers, each joins an NCCL process
+group. Here: one process per host; ``--device=tpu`` brings up the pod slice
+via ``initialize_distributed`` (autodetected on Cloud TPU, FRL_TPU_* env
+overrides for manual clusters); ``--device=cpu --sim-devices=8`` gives the
+simulated multi-chip CPU mesh used by the test tier (SURVEY C20).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _parse_args(argv=None):
+    p = argparse.ArgumentParser(description="FRL-TPU scaffold launcher")
+    p.add_argument("--config", help="registered config name (see --list-configs)")
+    p.add_argument("--device", default="tpu", choices=["tpu", "cpu"])
+    p.add_argument(
+        "--sim-devices",
+        type=int,
+        default=0,
+        help="with --device=cpu: number of simulated devices",
+    )
+    p.add_argument("--list-configs", action="store_true")
+    p.add_argument(
+        "--elastic",
+        action="store_true",
+        help="run under the elastic checkpoint-restart supervisor (SURVEY C14)",
+    )
+    p.add_argument(
+        "--coordinator", default=None, help="host:port for multi-host bring-up"
+    )
+    p.add_argument("--num-processes", type=int, default=None)
+    p.add_argument("--process-id", type=int, default=None)
+    p.add_argument(
+        "overrides", nargs="*", help="config overrides: path.to.field=value"
+    )
+    return p.parse_args(argv)
+
+
+def _configure_platform(args) -> None:
+    """Must run before jax initializes a backend."""
+    if args.device == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        if args.sim_devices > 1:
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags + f" --xla_force_host_platform_device_count={args.sim_devices}"
+                ).strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+
+def run_experiment(cfg, *, check_imports: bool = True):
+    """Train one config to completion; returns (state, last_metrics)."""
+    if check_imports:
+        _assert_no_cuda_imports()
+    from frl_distributed_ml_scaffold_tpu.trainer.loop import Trainer
+
+    trainer = Trainer(cfg)
+    return trainer.fit()
+
+
+def _assert_no_cuda_imports() -> None:
+    """The north-star constraint: zero CUDA/NCCL imports in the TPU path."""
+    banned = [m for m in sys.modules if m.startswith(("torch", "nccl", "cupy"))]
+    if banned:
+        raise RuntimeError(f"CUDA-path modules imported in TPU scaffold: {banned}")
+
+
+def main(argv=None) -> int:
+    args = _parse_args(argv)
+    from frl_distributed_ml_scaffold_tpu.config import (
+        apply_overrides,
+        get_config,
+        list_configs,
+        pretty_config,
+    )
+
+    if args.list_configs:
+        print("\n".join(list_configs()))
+        return 0
+    if not args.config:
+        print("--config is required (see --list-configs)", file=sys.stderr)
+        return 2
+
+    _configure_platform(args)
+
+    cfg = apply_overrides(get_config(args.config), args.overrides)
+
+    if args.elastic:
+        from frl_distributed_ml_scaffold_tpu.launcher.elastic import supervise
+
+        return supervise(args, cfg)
+
+    from frl_distributed_ml_scaffold_tpu.dist.initialize import initialize_distributed
+    from frl_distributed_ml_scaffold_tpu.utils.logging import get_logger
+
+    initialize_distributed(args.coordinator, args.num_processes, args.process_id)
+    logger = get_logger()
+    logger.info("launching %s\n%s", cfg.name, pretty_config(cfg))
+    _, last = run_experiment(cfg)
+    logger.info("done: %s", json.dumps(last, default=str))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

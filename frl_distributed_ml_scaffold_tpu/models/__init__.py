@@ -1,0 +1,44 @@
+"""Model zoo (SURVEY C15): the five reference families, flax-linen native.
+
+- ``mlp``    — MNIST MLP (BASELINE config 1)
+- ``resnet`` — ResNet-50 family (config 2)
+- ``vit``    — ViT-B/16 (config 3)
+- ``gpt``    — GPT-2-medium transformer LM, with TP/SP/EP-aware internals
+               (config 4 + task-required parallelisms)
+- ``video``  — tubelet-ViT video-clip classifier (config 5, Ego4D-style)
+
+``create_model(model_cfg)`` dispatches on the config's ``family`` tag and
+returns a flax Module. All modules take a precision ``Policy`` so compute
+dtype follows the AMP config (SURVEY C10).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from frl_distributed_ml_scaffold_tpu.precision import Policy
+
+
+def create_model(model_cfg: Any, policy: Policy):
+    family = getattr(model_cfg, "family", None)
+    if family == "mlp":
+        from frl_distributed_ml_scaffold_tpu.models.mlp import MLP
+
+        return MLP(config=model_cfg, policy=policy)
+    if family == "resnet":
+        from frl_distributed_ml_scaffold_tpu.models.resnet import ResNet
+
+        return ResNet(config=model_cfg, policy=policy)
+    if family == "vit":
+        from frl_distributed_ml_scaffold_tpu.models.vit import ViT
+
+        return ViT(config=model_cfg, policy=policy)
+    if family == "gpt":
+        from frl_distributed_ml_scaffold_tpu.models.gpt import GPT
+
+        return GPT(config=model_cfg, policy=policy)
+    if family == "video":
+        from frl_distributed_ml_scaffold_tpu.models.video import VideoClassifier
+
+        return VideoClassifier(config=model_cfg, policy=policy)
+    raise KeyError(f"unknown model family {family!r}")

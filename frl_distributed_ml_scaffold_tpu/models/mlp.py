@@ -1,0 +1,27 @@
+"""MLP classifier (BASELINE config 1: the MNIST smoke-test model)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from frl_distributed_ml_scaffold_tpu.config.schema import MLPConfig
+from frl_distributed_ml_scaffold_tpu.precision import Policy
+
+
+class MLP(nn.Module):
+    config: MLPConfig
+    policy: Policy
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
+        cfg = self.config
+        dtype = self.policy.compute_dtype
+        x = x.reshape((x.shape[0], -1)).astype(dtype)
+        for width in cfg.hidden_sizes:
+            x = nn.Dense(width, dtype=dtype)(x)
+            x = nn.relu(x)
+            if cfg.dropout > 0:
+                x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
+        x = nn.Dense(cfg.num_classes, dtype=dtype)(x)
+        return x.astype(self.policy.output_dtype)

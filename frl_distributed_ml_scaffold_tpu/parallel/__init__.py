@@ -1,0 +1,25 @@
+"""Parallelism layer (SURVEY C4–C9): strategies as sharding annotations.
+
+The reference implements DP/FSDP/ZeRO as *wrapper modules* (DDP, FSDP) and
+process-group plumbing. TPU-native, every strategy is a PartitionSpec
+assignment over one mesh:
+
+- DP      — params ``P()``, batch over ``("data","fsdp")``; GSPMD inserts the
+            gradient allreduce DDP's hooks did.
+- FSDP    — params sharded over ``fsdp`` (largest divisible dim); XLA
+            all-gathers params per layer and reduce-scatters grads — the
+            SimpleFSDP formulation (PAPERS.md).
+- ZeRO-1  — params replicated, optimizer state sharded over ``fsdp``.
+- TP      — Megatron column/row rules on attention/MLP weights (``model``).
+- SP      — ring attention / Ulysses over ``seq`` (ops/ring_attention.py).
+- EP      — MoE expert sharding over ``expert`` (models/moe.py).
+- PP      — stage assignment over ``pipe`` (parallel/pipeline.py).
+"""
+
+from frl_distributed_ml_scaffold_tpu.parallel.partition import (
+    PartitionRules,
+    fsdp_spec_for,
+    opt_state_specs,
+    param_specs,
+    shardings_from_specs,
+)

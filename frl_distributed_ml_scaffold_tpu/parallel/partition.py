@@ -1,0 +1,157 @@
+"""Partition-spec derivation: name rules + FSDP/ZeRO overlays (SURVEY C4–C5).
+
+Pipeline for deciding where every array lives:
+
+1. **Model rules** (optional): regex ``(pattern, PartitionSpec)`` pairs
+   matched against the param's path name — how TP expresses Megatron
+   column/row splits. First match wins; no match → replicated.
+2. **FSDP overlay** (``param_sharding="fsdp"``): any dimension not already
+   sharded gets the ``fsdp`` axis on the largest divisible dim. Leaves
+   smaller than ``min_size`` stay replicated (collective latency >> memory
+   saved).
+3. **Optimizer state** mirrors param specs by path-suffix matching (optax
+   states embed params-shaped subtrees, e.g. ``.../mu/<param path>``);
+   ``zero1`` instead *shards* those mirrors over ``fsdp`` while params stay
+   replicated.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Any, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from frl_distributed_ml_scaffold_tpu.config.schema import ParallelConfig
+from frl_distributed_ml_scaffold_tpu.utils.trees import named_tree_map, tree_path_names
+
+
+@dataclass(frozen=True)
+class PartitionRules:
+    """Ordered regex → PartitionSpec rules (first match wins)."""
+
+    rules: tuple[tuple[str, P], ...] = ()
+
+    def match(self, name: str) -> P | None:
+        for pattern, spec in self.rules:
+            if re.search(pattern, name):
+                return spec
+        return None
+
+
+def fsdp_spec_for(
+    shape: Sequence[int],
+    base: P,
+    *,
+    axis: str = "fsdp",
+    axis_size: int,
+    min_size: int,
+) -> P:
+    """Overlay the fsdp axis onto ``base`` for an array of ``shape``.
+
+    Picks the largest dimension that is (a) unsharded in ``base`` and
+    (b) divisible by ``axis_size``. Ties break toward the *first* such dim
+    (usually the input/feature dim, giving all-gather-friendly layouts).
+    """
+    if axis_size <= 1 or int(np.prod(shape)) < min_size:
+        return base
+    entries = list(base) + [None] * (len(shape) - len(base))
+    candidates = [
+        i
+        for i, (dim, e) in enumerate(zip(shape, entries))
+        if e is None and dim % axis_size == 0 and dim >= axis_size
+    ]
+    if not candidates:
+        return base
+    best = max(candidates, key=lambda i: shape[i])
+    entries[best] = axis
+    return P(*entries)
+
+
+def param_specs(
+    params: Any,
+    parallel: ParallelConfig,
+    mesh: Mesh,
+    rules: PartitionRules | None = None,
+) -> Any:
+    """PartitionSpec pytree for the parameters."""
+    fsdp_size = mesh.shape["fsdp"]
+
+    def decide(name: str, leaf) -> P:
+        base = (rules.match(name) if rules else None) or P()
+        if parallel.param_sharding == "fsdp":
+            return fsdp_spec_for(
+                leaf.shape,
+                base,
+                axis_size=fsdp_size,
+                min_size=parallel.fsdp_min_size,
+            )
+        if parallel.param_sharding == "replicated":
+            return base
+        raise ValueError(f"unknown param_sharding {parallel.param_sharding!r}")
+
+    return named_tree_map(decide, params)
+
+
+def opt_state_specs(
+    opt_state_shapes: Any,
+    params: Any,
+    p_specs: Any,
+    parallel: ParallelConfig,
+    mesh: Mesh,
+) -> Any:
+    """PartitionSpec pytree for the optimizer state.
+
+    ``opt_state_shapes`` should come from ``jax.eval_shape(tx.init, params)``
+    so no real memory is allocated. Leaves are matched to params by path
+    suffix: optax embeds params-shaped trees (``mu``, ``nu``, trace, …) whose
+    key paths end with the param's own path.
+    """
+    param_names = tree_path_names(params)
+    spec_leaves = jax.tree.leaves(p_specs, is_leaf=lambda x: isinstance(x, P))
+    param_shapes = [l.shape for l in jax.tree.leaves(params)]
+    # Longest path first: with nested modules, "Block_0/Dense_0/kernel" must
+    # win over a sibling "Dense_0/kernel" that is also a suffix. The shape
+    # check rejects any remaining same-suffix/different-array collisions.
+    by_name = sorted(
+        zip(param_names, spec_leaves, param_shapes), key=lambda t: -len(t[0])
+    )
+    fsdp_size = mesh.shape["fsdp"]
+
+    def decide(name: str, leaf) -> P:
+        if not hasattr(leaf, "shape") or leaf.shape == ():
+            return P()  # step counts etc.
+        matched: P | None = None
+        for pname, pspec, pshape in by_name:
+            if (name.endswith("/" + pname) or name == pname) and leaf.shape == pshape:
+                matched = pspec
+                break
+        if matched is None:
+            return P()
+        if parallel.opt_sharding == "zero1":
+            # ZeRO-1: shard the state mirror over fsdp even though params
+            # aren't. (If params are already fsdp-sharded this is a no-op
+            # overlay on top of the inherited spec.)
+            return fsdp_spec_for(
+                leaf.shape,
+                matched,
+                axis_size=fsdp_size,
+                min_size=parallel.fsdp_min_size,
+            )
+        if parallel.opt_sharding == "like_params":
+            return matched
+        raise ValueError(f"unknown opt_sharding {parallel.opt_sharding!r}")
+
+    return named_tree_map(decide, opt_state_shapes)
+
+
+def shardings_from_specs(specs: Any, mesh: Mesh) -> Any:
+    """PartitionSpec pytree → NamedSharding pytree."""
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )

@@ -1,0 +1,72 @@
+"""Mixed-precision policy (SURVEY C10) — the AMP equivalent, TPU-native.
+
+The reference uses autocast(bf16) + GradScaler. On TPU, bf16 has fp32's
+exponent range, so no loss scaling is needed; the whole AMP story reduces to
+a dtype policy: params are stored in ``param_dtype``, cast to
+``compute_dtype`` for the forward/backward, and gradients/optimizer math run
+in ``param_dtype``. Collective reductions ride ``reduce_dtype`` (fp32 keeps
+large-mesh gradient sums stable).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from frl_distributed_ml_scaffold_tpu.config.schema import PrecisionConfig
+
+
+@dataclass(frozen=True)
+class Policy:
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.float32
+    output_dtype: Any = jnp.float32  # loss/logits dtype
+    reduce_dtype: Any = jnp.float32
+
+    def cast_to_compute(self, tree: Any) -> Any:
+        return _cast_floats(tree, self.compute_dtype)
+
+    def cast_to_param(self, tree: Any) -> Any:
+        return _cast_floats(tree, self.param_dtype)
+
+    def cast_to_output(self, tree: Any) -> Any:
+        return _cast_floats(tree, self.output_dtype)
+
+
+def _cast_floats(tree: Any, dtype: Any) -> Any:
+    def cast(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree.map(cast, tree)
+
+
+_POLICIES = {
+    # Full fp32: debugging / CPU-sim numerics reference.
+    "fp32": Policy(),
+    # Pure bf16: maximum speed, params also bf16 (used for inference).
+    "bf16": Policy(
+        param_dtype=jnp.bfloat16,
+        compute_dtype=jnp.bfloat16,
+        output_dtype=jnp.bfloat16,
+        reduce_dtype=jnp.float32,
+    ),
+    # The "bf16 AMP" equivalent: fp32 master params, bf16 compute.
+    "bf16_mixed": Policy(
+        param_dtype=jnp.float32,
+        compute_dtype=jnp.bfloat16,
+        output_dtype=jnp.float32,
+        reduce_dtype=jnp.float32,
+    ),
+}
+
+
+def get_policy(cfg: PrecisionConfig | str) -> Policy:
+    name = cfg if isinstance(cfg, str) else cfg.policy
+    if name not in _POLICIES:
+        raise KeyError(f"unknown precision policy {name!r}; have {sorted(_POLICIES)}")
+    return _POLICIES[name]

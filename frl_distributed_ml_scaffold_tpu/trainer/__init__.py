@@ -1,0 +1,15 @@
+"""Trainer (SURVEY C3): the step loop as one compiled XLA program.
+
+``Trainer`` replaces the reference's fit-loop + DDP/FSDP wrapping + AMP
+autocast + GradScaler with: sharded state init, a single jit-compiled
+``train_step`` (donated state, GSPMD-inserted collectives), a step-indexed
+data pipeline, device-side metrics, and checkpoint/eval hooks.
+"""
+
+from frl_distributed_ml_scaffold_tpu.trainer.loop import Trainer
+from frl_distributed_ml_scaffold_tpu.precision import Policy, get_policy
+from frl_distributed_ml_scaffold_tpu.trainer.train_state import TrainState
+from frl_distributed_ml_scaffold_tpu.trainer.train_step import (
+    make_eval_step,
+    make_train_step,
+)

@@ -1,0 +1,227 @@
+"""Trainer: sharded init + compiled step + host dispatch loop (SURVEY C3).
+
+Call stack (a)/(b) TPU-native: build mesh → init state *directly sharded*
+(``jit(create_state, out_shardings=...)`` — parameters materialize on their
+home devices, no host-side full copy, which is what makes FSDP-init of
+models bigger than one chip's HBM possible) → dispatch loop. The loop's only
+per-step work is building the next batch and dispatching the async step;
+metrics are fetched every ``log_every`` steps.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from frl_distributed_ml_scaffold_tpu.config.schema import ExperimentConfig
+from frl_distributed_ml_scaffold_tpu.data.pipeline import build_pipeline
+from frl_distributed_ml_scaffold_tpu.dist.mesh import MeshEnv, build_mesh
+from frl_distributed_ml_scaffold_tpu.models import create_model
+from frl_distributed_ml_scaffold_tpu.parallel.partition import (
+    PartitionRules,
+    opt_state_specs,
+    param_specs,
+    shardings_from_specs,
+)
+from frl_distributed_ml_scaffold_tpu.trainer.optimizers import make_optimizer
+from frl_distributed_ml_scaffold_tpu.precision import get_policy
+from frl_distributed_ml_scaffold_tpu.trainer.tasks import example_input, make_loss_fn
+from frl_distributed_ml_scaffold_tpu.trainer.train_state import TrainState
+from frl_distributed_ml_scaffold_tpu.trainer.train_step import (
+    make_eval_step,
+    make_train_step,
+)
+from frl_distributed_ml_scaffold_tpu.utils.logging import MetricLogger, get_logger
+from frl_distributed_ml_scaffold_tpu.utils.timing import StepTimer
+from frl_distributed_ml_scaffold_tpu.utils.trees import tree_param_count
+
+
+def model_partition_rules(model_cfg: Any, env: MeshEnv) -> PartitionRules | None:
+    """TP rules when the model axis is populated (SURVEY C6)."""
+    if env.axis_size("model") <= 1:
+        return None
+    family = getattr(model_cfg, "family", None)
+    if family == "gpt":
+        from frl_distributed_ml_scaffold_tpu.models.gpt import gpt_tp_rules
+
+        return gpt_tp_rules()
+    return None
+
+
+class Trainer:
+    """End-to-end training driver for one ExperimentConfig."""
+
+    def __init__(self, cfg: ExperimentConfig, *, mesh_env: MeshEnv | None = None):
+        self.cfg = cfg
+        self.logger = get_logger()
+        self.env = mesh_env if mesh_env is not None else build_mesh(cfg.mesh)
+        self.policy = get_policy(cfg.precision)
+        self.model = create_model(cfg.model, self.policy)
+        self.tx, self.schedule = make_optimizer(cfg.optimizer, cfg.trainer)
+        self.loss_fn = make_loss_fn(self.model, cfg.data.name)
+        self.pipeline = build_pipeline(cfg.data, self.env, split="train")
+        self._eval_pipeline = None
+        self.checkpointer = None  # attached by attach_checkpointer()
+        if cfg.checkpoint.enabled:
+            from frl_distributed_ml_scaffold_tpu.checkpoint.manager import (
+                Checkpointer,
+            )
+
+            self.attach_checkpointer(
+                Checkpointer(os.path.join(cfg.workdir, cfg.name, "ckpt"), cfg.checkpoint)
+            )
+
+        self._build_state_shardings()
+        self._compile_steps()
+
+    # ---------------------------------------------------------------- setup
+
+    def _init_state_fn(self, rng):
+        x = example_input(self.cfg.data, self.cfg.model)
+        key = "tokens" if "tokens" in x else ("video" if "video" in x else "image")
+        inp = jnp.asarray(x[key][:, :-1] if key == "tokens" else x[key])
+        variables = self.model.init({"params": rng}, inp, train=False)
+        return TrainState.create(variables["params"], self.tx)
+
+    def _build_state_shardings(self) -> None:
+        cfg, env = self.cfg, self.env
+        rng = jax.random.key(cfg.trainer.seed)
+        state_shapes = jax.eval_shape(self._init_state_fn, rng)
+        rules = model_partition_rules(cfg.model, env)
+        p_specs = param_specs(state_shapes.params, cfg.parallel, env.mesh, rules)
+        o_specs = opt_state_specs(
+            state_shapes.opt_state, state_shapes.params, p_specs, cfg.parallel, env.mesh
+        )
+        self.state_specs = TrainState(step=P(), params=p_specs, opt_state=o_specs)
+        self.state_shardings = shardings_from_specs(self.state_specs, env.mesh)
+        self.state_shapes = state_shapes
+        self._rng = rng
+
+    def init_state(self) -> TrainState:
+        """Initialize the train state directly into its shardings."""
+        state = jax.jit(self._init_state_fn, out_shardings=self.state_shardings)(
+            self._rng
+        )
+        n_params = tree_param_count(state.params)
+        self.logger.info(
+            "initialized %s: %.2fM params over mesh %s",
+            self.cfg.name,
+            n_params / 1e6,
+            dict(self.env.mesh.shape),
+        )
+        return state
+
+    def _batch_shardings(self, batch: dict) -> dict:
+        return self.pipeline.shardings_for(
+            {k: np.asarray(v) for k, v in batch.items()}
+        )
+
+    def _compile_steps(self) -> None:
+        cfg = self.cfg
+        step_fn = make_train_step(
+            self.loss_fn,
+            self.tx,
+            self.policy,
+            seed=cfg.trainer.seed,
+            grad_accum=cfg.trainer.grad_accum,
+            remat=cfg.trainer.remat,
+        )
+        # Batch shardings are inferred from the example batch structure.
+        example = example_input(cfg.data, cfg.model)
+        batch_sh = self._batch_shardings(example)
+        self.train_step = jax.jit(
+            step_fn,
+            in_shardings=(self.state_shardings, batch_sh),
+            out_shardings=(self.state_shardings, None),
+            donate_argnums=(0,),
+        )
+        eval_fn = make_eval_step(self.loss_fn, self.policy, seed=cfg.trainer.seed)
+        self.eval_step = jax.jit(
+            eval_fn, in_shardings=(self.state_shardings, batch_sh)
+        )
+
+    # ----------------------------------------------------------------- loop
+
+    def attach_checkpointer(self, checkpointer) -> None:
+        self.checkpointer = checkpointer
+
+    def fit(
+        self,
+        state: TrainState | None = None,
+        *,
+        num_steps: int | None = None,
+        on_step: Callable[[int, dict], None] | None = None,
+    ) -> tuple[TrainState, dict]:
+        """Run the training loop; returns (final_state, last_metrics)."""
+        cfg = self.cfg
+        total = num_steps if num_steps is not None else cfg.trainer.total_steps
+
+        if state is None:
+            if self.checkpointer is not None and cfg.checkpoint.resume:
+                state = self.checkpointer.restore_or_init(self)
+            else:
+                state = self.init_state()
+        # The state's own step counter is the resume point — holds for both
+        # checkpoint restores and explicitly passed states, and keeps the
+        # step-indexed data stream aligned with what the model has seen.
+        start_step = int(jax.device_get(state.step))
+
+        metric_logger = MetricLogger(
+            os.path.join(cfg.workdir, cfg.name, "metrics.jsonl")
+        )
+        timer = StepTimer(warmup=1)  # first window contains compile
+        samples_per_step = cfg.data.global_batch_size
+        last_record: dict = {}
+        last_logged = start_step
+
+        for step in range(start_step, total):
+            batch = self.pipeline.global_batch(step)
+            state, metrics = self.train_step(state, batch)
+            if (step + 1) % cfg.trainer.log_every == 0 or step + 1 == total:
+                timer.tick_window(metrics["loss"], step + 1 - last_logged)
+                last_logged = step + 1
+                perf = timer.summary(samples_per_step)
+                extra = {
+                    "lr": float(self.schedule(step)),
+                    **{
+                        k: round(v, 6)
+                        for k, v in perf.items()
+                        if k in ("step_time_median_s", "samples_per_sec_per_chip")
+                    },
+                }
+                last_record = metric_logger.log(step + 1, metrics, extra)
+            if on_step is not None:
+                on_step(step, metrics)
+            if (
+                self.checkpointer is not None
+                and (step + 1) % cfg.checkpoint.save_every == 0
+            ):
+                self.checkpointer.save(step + 1, state)
+            if cfg.trainer.eval_every and (step + 1) % cfg.trainer.eval_every == 0:
+                eval_metrics = self.evaluate(state)
+                metric_logger.log(step + 1, eval_metrics, {"split": "eval"})
+
+        if self.checkpointer is not None:
+            if total % cfg.checkpoint.save_every != 0:
+                # Final state not yet covered by the periodic save above.
+                self.checkpointer.save(total, state, force=True)
+            self.checkpointer.wait()
+        metric_logger.close()
+        return state, last_record
+
+    def evaluate(self, state: TrainState, num_steps: int | None = None) -> dict:
+        if self._eval_pipeline is None:
+            self._eval_pipeline = build_pipeline(self.cfg.data, self.env, split="eval")
+        n = num_steps or self.cfg.trainer.eval_steps
+        acc: dict[str, Any] = {}
+        for step in range(n):
+            batch = self._eval_pipeline.global_batch(step)
+            m = self.eval_step(state, batch)
+            acc = m if not acc else jax.tree.map(lambda a, b: a + b, acc, m)
+        mean = jax.tree.map(lambda x: x / n, acc)
+        return {f"eval_{k}": v for k, v in jax.device_get(mean).items()}

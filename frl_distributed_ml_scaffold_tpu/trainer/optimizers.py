@@ -1,0 +1,59 @@
+"""Optimizer + LR-schedule factory (part of SURVEY C3).
+
+Thin optax composition: clip → optimizer → schedule. Kept as one factory so
+the ZeRO layer (parallel/partition.py) can derive optimizer-state sharding
+from ``jax.eval_shape(tx.init, params)`` for anything built here.
+"""
+
+from __future__ import annotations
+
+import optax
+
+from frl_distributed_ml_scaffold_tpu.config.schema import OptimizerConfig, TrainerConfig
+
+
+def make_schedule(cfg: OptimizerConfig, total_steps: int) -> optax.Schedule:
+    base = cfg.learning_rate
+    decay_steps = max(total_steps - cfg.warmup_steps, 1)
+    if cfg.schedule == "constant":
+        sched = optax.constant_schedule(base)
+    elif cfg.schedule == "cosine":
+        sched = optax.cosine_decay_schedule(base, decay_steps)
+    elif cfg.schedule == "linear":
+        sched = optax.linear_schedule(base, 0.0, decay_steps)
+    else:
+        raise KeyError(f"unknown schedule {cfg.schedule!r}")
+    if cfg.warmup_steps > 0:
+        warmup = optax.linear_schedule(0.0, base, cfg.warmup_steps)
+        return optax.join_schedules([warmup, sched], [cfg.warmup_steps])
+    return sched
+
+
+def make_optimizer(
+    cfg: OptimizerConfig, trainer_cfg: TrainerConfig
+) -> tuple[optax.GradientTransformation, optax.Schedule]:
+    """Build the optax chain; returns (tx, schedule) — schedule exposed for
+    LR logging."""
+    schedule = make_schedule(cfg, trainer_cfg.total_steps)
+    parts = []
+    if cfg.grad_clip_norm is not None:
+        parts.append(optax.clip_by_global_norm(cfg.grad_clip_norm))
+    if cfg.name == "adamw":
+        parts.append(
+            optax.adamw(
+                schedule,
+                b1=cfg.b1,
+                b2=cfg.b2,
+                eps=cfg.eps,
+                weight_decay=cfg.weight_decay,
+            )
+        )
+    elif cfg.name == "adam":
+        parts.append(optax.adam(schedule, b1=cfg.b1, b2=cfg.b2, eps=cfg.eps))
+    elif cfg.name == "sgd":
+        if cfg.weight_decay:
+            parts.append(optax.add_decayed_weights(cfg.weight_decay))
+        parts.append(optax.sgd(schedule, momentum=cfg.momentum, nesterov=True))
+    else:
+        raise KeyError(f"unknown optimizer {cfg.name!r}")
+    return optax.chain(*parts), schedule

@@ -1,0 +1,7 @@
+"""Re-export shim: the precision policy lives at the package top level so
+models/ can import it without pulling in the trainer package (which imports
+models — a cycle otherwise)."""
+
+from frl_distributed_ml_scaffold_tpu.precision import Policy, get_policy
+
+__all__ = ["Policy", "get_policy"]

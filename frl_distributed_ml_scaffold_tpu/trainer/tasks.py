@@ -1,0 +1,97 @@
+"""Task loss functions: the glue between models and the compiled step.
+
+``make_loss_fn`` returns the ``loss_fn(params, batch, rng, train)``
+contract that train_step.py consumes. Loss math runs in fp32 regardless of
+compute dtype (softmax/CE in bf16 loses too much precision).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def _apply(model, params, x, rng, train: bool):
+    rngs = {"dropout": rng} if train else None
+    return model.apply({"params": params}, x, train=train, rngs=rngs)
+
+
+def make_classification_loss(model, input_key: str = "image"):
+    def loss_fn(params, batch, rng, train):
+        logits = _apply(model, params, batch[input_key], rng, train)
+        logits = logits.astype(jnp.float32)
+        labels = batch["label"]
+        loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+        acc = (jnp.argmax(logits, -1) == labels).astype(jnp.float32).mean()
+        return loss, {"accuracy": acc}
+
+    return loss_fn
+
+
+def make_lm_loss(model):
+    """Next-token CE over ``batch["tokens"]`` (shape [B, L+1])."""
+
+    def loss_fn(params, batch, rng, train):
+        tokens = batch["tokens"]
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        out = _apply(model, params, inputs, rng, train)
+        # MoE models return (logits, aux_loss); dense return logits.
+        aux_loss = jnp.zeros((), jnp.float32)
+        if isinstance(out, tuple):
+            logits, aux_loss = out
+        else:
+            logits = out
+        logits = logits.astype(jnp.float32)
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets).mean()
+        loss = ce + aux_loss
+        metrics = {"ce_loss": ce, "perplexity": jnp.exp(ce)}
+        if isinstance(out, tuple):
+            metrics["aux_loss"] = aux_loss
+        return loss, metrics
+
+    return loss_fn
+
+
+def make_loss_fn(model, data_name: str):
+    if data_name in ("mnist", "synthetic_mnist", "imagenet", "synthetic_imagenet"):
+        return make_classification_loss(model, "image")
+    if data_name in ("video", "video_synthetic"):
+        return make_classification_loss(model, "video")
+    if data_name in ("lm", "lm_synthetic"):
+        return make_lm_loss(model)
+    raise KeyError(f"no task for dataset {data_name!r}")
+
+
+def example_input(data_cfg, model_cfg) -> dict[str, Any]:
+    """A single-element batch for model init/shape inference."""
+    import numpy as np
+
+    name = data_cfg.name
+    if name in ("mnist", "synthetic_mnist", "imagenet", "synthetic_imagenet"):
+        return {
+            "image": np.zeros(
+                (1, data_cfg.image_size, data_cfg.image_size, data_cfg.channels),
+                np.float32,
+            ),
+            "label": np.zeros((1,), np.int32),
+        }
+    if name in ("video", "video_synthetic"):
+        return {
+            "video": np.zeros(
+                (
+                    1,
+                    data_cfg.num_frames,
+                    data_cfg.image_size,
+                    data_cfg.image_size,
+                    data_cfg.channels,
+                ),
+                np.float32,
+            ),
+            "label": np.zeros((1,), np.int32),
+        }
+    if name in ("lm", "lm_synthetic"):
+        return {"tokens": np.zeros((1, data_cfg.seq_len + 1), np.int32)}
+    raise KeyError(f"no example input for dataset {name!r}")

@@ -1,0 +1,29 @@
+"""Train state: the one pytree that is sharded, stepped, and checkpointed.
+
+Kept to pure arrays (step/params/opt_state) — apply_fn and the optimizer are
+closed over by the compiled step instead of stored as static fields, so the
+state maps 1:1 onto sharding-spec trees and Orbax checkpoints with no
+filtering.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.struct
+import jax.numpy as jnp
+import optax
+
+
+class TrainState(flax.struct.PyTreeNode):
+    step: Any  # int32 scalar array
+    params: Any
+    opt_state: Any
+
+    @classmethod
+    def create(cls, params: Any, tx: optax.GradientTransformation) -> "TrainState":
+        return cls(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=tx.init(params),
+        )

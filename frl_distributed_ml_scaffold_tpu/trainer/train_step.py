@@ -1,0 +1,143 @@
+"""The compiled training step (SURVEY C3, C11, C12; call stack (b)).
+
+Reference hot loop: autocast forward → ``loss.backward()`` with DDP hooks
+firing bucketed NCCL allreduces → ``optimizer.step()``. TPU-native, all of
+that is ONE XLA program: forward, backward, gradient collectives (inserted
+by GSPMD from shardings), and the optax update, compiled together so XLA's
+latency-hiding scheduler overlaps collectives with compute. The host's only
+per-step job is dispatching this function — anything else per-step on host
+is a bug (SURVEY call stack (b)).
+
+- Grad accumulation (C12): ``lax.scan`` over microbatches with an fp32
+  accumulator, inside the same compiled program.
+- Remat (C11): ``jax.checkpoint`` around the loss fn ("full") or with the
+  save-dots policy ("dots").
+- AMP (C10): params cast to the policy's compute dtype for fwd/bwd;
+  gradients cast back to fp32 for the optimizer update.
+
+``loss_fn(params, batch, rng, train)`` → ``(loss, metrics_dict)`` is the
+only model-facing contract; recipes build it in trainer/tasks.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+
+from frl_distributed_ml_scaffold_tpu.precision import Policy
+from frl_distributed_ml_scaffold_tpu.trainer.train_state import TrainState
+
+LossFn = Callable[..., tuple[jax.Array, dict[str, jax.Array]]]
+
+
+def _remat_wrap(loss_fn: LossFn, remat: str) -> LossFn:
+    if remat == "none":
+        return loss_fn
+    if remat == "full":
+        return jax.checkpoint(loss_fn, static_argnums=(3,))
+    if remat == "dots":
+        return jax.checkpoint(
+            loss_fn,
+            policy=jax.checkpoint_policies.checkpoint_dots,
+            static_argnums=(3,),
+        )
+    raise KeyError(f"unknown remat mode {remat!r}")
+
+
+def make_train_step(
+    loss_fn: LossFn,
+    tx: optax.GradientTransformation,
+    policy: Policy,
+    *,
+    seed: int = 0,
+    grad_accum: int = 1,
+    remat: str = "none",
+) -> Callable[[TrainState, Any], tuple[TrainState, dict[str, jax.Array]]]:
+    """Build the (unjitted) step function; the Trainer jits it with shardings.
+
+    RNG: derived inside the program as ``fold_in(key(seed), step)`` — every
+    process computes the same key with zero host traffic, which is what keeps
+    multi-host dropout/augmentation coherent.
+    """
+    wrapped = _remat_wrap(loss_fn, remat)
+    grad_fn = jax.value_and_grad(wrapped, has_aux=True)
+
+    def single(params_c, batch, rng):
+        (loss, metrics), grads = grad_fn(params_c, batch, rng, True)
+        return loss, metrics, grads
+
+    def accumulated(params_c, batch, rng):
+        def reshape(x):
+            if x.shape[0] % grad_accum:
+                raise ValueError(
+                    f"batch dim {x.shape[0]} not divisible by grad_accum={grad_accum}"
+                )
+            return x.reshape((grad_accum, x.shape[0] // grad_accum) + x.shape[1:])
+
+        micro = jax.tree.map(reshape, batch)
+        rngs = jax.random.split(rng, grad_accum)
+        zero_grads = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, policy.reduce_dtype), params_c
+        )
+
+        def body(carry, xs):
+            g_acc, l_acc, m_acc = carry
+            mb, r = xs
+            (loss, metrics), grads = grad_fn(params_c, mb, r, True)
+            g_acc = jax.tree.map(
+                lambda a, g: a + g.astype(policy.reduce_dtype), g_acc, grads
+            )
+            m_acc = jax.tree.map(lambda a, m: a + m, m_acc, metrics)
+            return (g_acc, l_acc + loss, m_acc), None
+
+        zero_metrics = jax.tree.map(
+            lambda _: jnp.zeros((), jnp.float32),
+            jax.eval_shape(lambda: wrapped(params_c, jax.tree.map(lambda x: x[0], micro), rngs[0], True)[1])
+        )
+        (grads, loss, metrics), _ = lax.scan(
+            body, (zero_grads, jnp.zeros((), jnp.float32), zero_metrics), (micro, rngs)
+        )
+        inv = 1.0 / grad_accum
+        return (
+            loss * inv,
+            jax.tree.map(lambda m: m * inv, metrics),
+            jax.tree.map(lambda g: g * inv, grads),
+        )
+
+    def step_fn(state: TrainState, batch: Any) -> tuple[TrainState, dict]:
+        rng = jax.random.fold_in(jax.random.key(seed), state.step)
+        params_c = policy.cast_to_compute(state.params)
+        if grad_accum > 1:
+            loss, metrics, grads = accumulated(params_c, batch, rng)
+        else:
+            loss, metrics, grads = single(params_c, batch, rng)
+        grads = policy.cast_to_param(grads)
+        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        out_metrics = dict(metrics)
+        out_metrics["loss"] = loss.astype(jnp.float32)
+        out_metrics["grad_norm"] = optax.global_norm(grads).astype(jnp.float32)
+        new_state = state.replace(
+            step=state.step + 1, params=new_params, opt_state=new_opt_state
+        )
+        return new_state, out_metrics
+
+    return step_fn
+
+
+def make_eval_step(loss_fn: LossFn, policy: Policy, *, seed: int = 0):
+    """Forward-only metrics step (call stack (e))."""
+
+    def eval_fn(state: TrainState, batch: Any) -> dict[str, jax.Array]:
+        rng = jax.random.fold_in(jax.random.key(seed + 1), state.step)
+        params_c = policy.cast_to_compute(state.params)
+        loss, metrics = loss_fn(params_c, batch, rng, False)
+        out = dict(metrics)
+        out["loss"] = loss.astype(jnp.float32)
+        return out
+
+    return eval_fn

@@ -1,0 +1,13 @@
+"""Utility layer: pytree paths, logging, timing, profiling (SURVEY C18, C19)."""
+
+from frl_distributed_ml_scaffold_tpu.utils.trees import (
+    named_tree_map,
+    tree_path_names,
+    tree_size_bytes,
+)
+from frl_distributed_ml_scaffold_tpu.utils.logging import (
+    JsonlWriter,
+    MetricLogger,
+    get_logger,
+)
+from frl_distributed_ml_scaffold_tpu.utils.timing import StepTimer

@@ -1,0 +1,115 @@
+"""Logging + metric emission (SURVEY C18).
+
+Design: metrics are accumulated *on device* inside the compiled step (the
+trainer returns a small metrics pytree); the host only periodically
+``device_get``s and writes them. Process-0 gating replaces the reference's
+rank-0 gating. Output is both human stdout and machine JSONL — samples/sec/
+chip and step time are first-class because they ARE the baseline metric
+(BASELINE.md measurement protocol).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import time
+from typing import Any, IO, Mapping
+
+import jax
+
+_LOGGERS: dict[str, logging.Logger] = {}
+
+
+def is_primary_process() -> bool:
+    """True on the process that should write logs (reference: rank 0)."""
+    return jax.process_index() == 0
+
+
+def get_logger(name: str = "frl_tpu") -> logging.Logger:
+    """Process-0-gated stdout logger; non-primary processes log at ERROR."""
+    if name in _LOGGERS:
+        return _LOGGERS[name]
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        handler = logging.StreamHandler(sys.stdout)
+        handler.setFormatter(
+            logging.Formatter("%(asctime)s %(levelname)s %(name)s] %(message)s")
+        )
+        logger.addHandler(handler)
+        logger.setLevel(logging.INFO if is_primary_process() else logging.ERROR)
+        logger.propagate = False
+    _LOGGERS[name] = logger
+    return logger
+
+
+class JsonlWriter:
+    """Append-only JSONL metric sink, primary-process only."""
+
+    def __init__(self, path: str | None):
+        self._fh: IO[str] | None = None
+        if path and is_primary_process():
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            self._fh = open(path, "a", buffering=1)
+
+    def write(self, record: Mapping[str, Any]) -> None:
+        if self._fh is not None:
+            self._fh.write(json.dumps(record, default=_json_default) + "\n")
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+def _json_default(x: Any) -> Any:
+    if hasattr(x, "item"):
+        return x.item()
+    if hasattr(x, "tolist"):
+        return x.tolist()
+    return str(x)
+
+
+class MetricLogger:
+    """Periodic metric emitter: stdout line + JSONL record.
+
+    ``log(step, metrics, extra)`` converts device scalars to Python floats
+    (one ``device_get`` for the whole dict) and writes both sinks.
+    """
+
+    def __init__(self, jsonl_path: str | None = None, name: str = "frl_tpu"):
+        self._logger = get_logger(name)
+        self._jsonl = JsonlWriter(jsonl_path)
+        self._start = time.monotonic()
+
+    def log(
+        self,
+        step: int,
+        metrics: Mapping[str, Any],
+        extra: Mapping[str, Any] | None = None,
+    ) -> dict[str, Any]:
+        host_metrics = jax.device_get(dict(metrics))
+        record: dict[str, Any] = {
+            "step": int(step),
+            "wall_time_s": round(time.monotonic() - self._start, 3),
+        }
+        for k, v in host_metrics.items():
+            try:
+                record[k] = float(v)
+            except (TypeError, ValueError):
+                record[k] = v
+        if extra:
+            record.update(extra)
+        parts = [f"step={record['step']}"]
+        parts += [
+            f"{k}={v:.6g}" if isinstance(v, float) else f"{k}={v}"
+            for k, v in record.items()
+            if k not in ("step",)
+        ]
+        self._logger.info(" ".join(parts))
+        self._jsonl.write(record)
+        return record
+
+    def close(self) -> None:
+        self._jsonl.close()

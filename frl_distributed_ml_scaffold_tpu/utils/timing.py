@@ -1,0 +1,92 @@
+"""Step timing + throughput measurement (SURVEY C19, BASELINE.md protocol).
+
+The contract: timings exclude compile (warmup window), are measured with
+``jax.block_until_ready`` on the step output, and report median + p90 e2e
+step time plus samples/sec/chip — the benchmark harness and the trainer both
+use this one implementation so numbers agree.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import jax
+import numpy as np
+
+
+@dataclass
+class StepTimer:
+    """Collects per-step wall times after a warmup window.
+
+    Usage::
+
+        timer = StepTimer(warmup=3)
+        for batch in data:
+            out = train_step(state, batch)
+            timer.tick(out)          # block_until_ready + record
+    """
+
+    warmup: int = 3
+    _times: list[float] = field(default_factory=list)
+    _seen: int = 0
+    _last: float | None = None
+
+    def tick(self, out=None) -> float | None:
+        """Mark the end of a step; returns this step's time (or None in warmup)."""
+        if out is not None:
+            jax.block_until_ready(out)
+        now = time.perf_counter()
+        dt = None
+        if self._last is not None:
+            self._seen += 1
+            if self._seen > self.warmup:
+                dt = now - self._last
+                self._times.append(dt)
+        self._last = now
+        return dt
+
+    def tick_window(self, out, steps: int) -> float | None:
+        """Record a window of ``steps`` steps ending now; appends the
+        *per-step average* for the window. Used by the training loop, which
+        only blocks on device output at log boundaries (blocking every step
+        would serialize the async dispatch pipeline). The first window is
+        dropped (contains compile)."""
+        if out is not None:
+            jax.block_until_ready(out)
+        now = time.perf_counter()
+        dt = None
+        if self._last is not None:
+            self._seen += 1
+            if self._seen > self.warmup:
+                dt = (now - self._last) / max(steps, 1)
+                self._times.extend([dt] * steps)
+        self._last = now
+        return dt
+
+    def reset(self) -> None:
+        self._times.clear()
+        self._seen = 0
+        self._last = None
+
+    @property
+    def count(self) -> int:
+        return len(self._times)
+
+    def summary(self, samples_per_step: int | None = None) -> dict:
+        """Median/p90 step time; samples/sec/chip if batch size given."""
+        if not self._times:
+            return {"steps_timed": 0}
+        arr = np.asarray(self._times)
+        out = {
+            "steps_timed": int(arr.size),
+            "step_time_median_s": float(np.median(arr)),
+            "step_time_p90_s": float(np.percentile(arr, 90)),
+            "step_time_mean_s": float(arr.mean()),
+            "steps_per_sec": float(1.0 / np.median(arr)),
+        }
+        if samples_per_step is not None:
+            n_chips = jax.device_count()
+            out["samples_per_sec"] = float(samples_per_step / np.median(arr))
+            out["samples_per_sec_per_chip"] = out["samples_per_sec"] / n_chips
+        return out

@@ -1,0 +1,73 @@
+"""Pytree path utilities.
+
+The parallelism layer assigns shardings to parameters by *name* (regex rules
+over ``"path/to/leaf"`` strings — SURVEY C4–C9), so a canonical flat naming of
+any pytree is load-bearing infrastructure. Built on ``jax.tree_util`` key
+paths so it works for dicts, dataclasses, optax states, and flax param trees
+alike.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import numpy as np
+
+
+def _key_entry_to_str(entry: Any) -> str:
+    """Render one tree_util key entry as a path segment."""
+    if isinstance(entry, jax.tree_util.DictKey):
+        return str(entry.key)
+    if isinstance(entry, jax.tree_util.SequenceKey):
+        return str(entry.idx)
+    if isinstance(entry, jax.tree_util.GetAttrKey):
+        return str(entry.name)
+    if isinstance(entry, jax.tree_util.FlattenedIndexKey):
+        return str(entry.key)
+    # Fallback: strip tree_util's decoration (e.g. "['a']" -> "a").
+    return str(entry).strip("[]'\".")
+
+
+def path_str(path: tuple, sep: str = "/") -> str:
+    """Join a tree_util key path into a ``"a/b/c"`` string."""
+    return sep.join(_key_entry_to_str(p) for p in path)
+
+
+def named_tree_map(
+    fn: Callable[[str, Any], Any], tree: Any, *rest: Any, sep: str = "/"
+) -> Any:
+    """``tree_map`` where ``fn`` receives ``(name, leaf, *rest_leaves)``.
+
+    ``name`` is the slash-joined key path of the leaf. This is the primitive
+    under regex-based partition-rule matching.
+    """
+    return jax.tree_util.tree_map_with_path(
+        lambda p, x, *r: fn(path_str(p, sep), x, *r), tree, *rest
+    )
+
+
+def tree_path_names(tree: Any, sep: str = "/") -> list[str]:
+    """Flat list of leaf path names, in tree_flatten order."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [path_str(p, sep) for p, _ in flat]
+
+
+def tree_size_bytes(tree: Any) -> int:
+    """Total bytes of all array leaves (params + opt state accounting)."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if hasattr(leaf, "nbytes"):
+            total += int(leaf.nbytes)
+        elif hasattr(leaf, "size") and hasattr(leaf, "dtype"):
+            total += int(leaf.size) * np.dtype(leaf.dtype).itemsize
+    return total
+
+
+def tree_param_count(tree: Any) -> int:
+    """Total element count of all array leaves."""
+    return sum(
+        int(np.prod(leaf.shape))
+        for leaf in jax.tree_util.tree_leaves(tree)
+        if hasattr(leaf, "shape")
+    )

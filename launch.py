@@ -1,0 +1,12 @@
+#!/usr/bin/env python
+"""Repo-root launcher shim: ``python launch.py --config=... [overrides]``."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from frl_distributed_ml_scaffold_tpu.launcher.launch import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

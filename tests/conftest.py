@@ -1,0 +1,29 @@
+"""Test harness: simulated 8-device CPU mesh (SURVEY §4, C20).
+
+Must run before jax is imported anywhere: forces the host platform and 8
+virtual CPU devices so every parallelism mode (DP/FSDP/TP/PP/SP/EP) runs real
+meshes and real collectives in pytest without TPU hardware — the TPU-native
+replacement for the reference's Gloo/fake-process-group test tier.
+"""
+
+import os
+import sys
+
+# Overwrite (not setdefault): the environment pins JAX_PLATFORMS=axon (the
+# real TPU plugin); tests must run on the simulated CPU mesh regardless.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# Repo root on sys.path so the package imports without installation.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The axon TPU plugin (injected via sitecustomize on PYTHONPATH) overrides
+# jax_platforms at the jax.config level, which beats the env var — override
+# it back before any backend is initialized.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

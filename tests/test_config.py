@@ -1,0 +1,84 @@
+"""Config system tests (SURVEY §4 unit tier, C17)."""
+
+import dataclasses
+
+import pytest
+
+from frl_distributed_ml_scaffold_tpu.config import (
+    ExperimentConfig,
+    MLPConfig,
+    apply_overrides,
+    config_from_dict,
+    config_to_dict,
+    get_config,
+    list_configs,
+)
+
+
+def test_registry_has_five_baseline_recipes():
+    names = list_configs()
+    for required in (
+        "mnist_mlp",
+        "imagenet_rn50_ddp",
+        "imagenet_vitb_fsdp",
+        "gpt2_medium_zero1",
+        "ego4d_video_elastic",
+    ):
+        assert required in names
+
+
+def test_get_config_returns_fresh_frozen_instances():
+    a = get_config("mnist_mlp")
+    b = get_config("mnist_mlp")
+    assert a == b
+    with pytest.raises(dataclasses.FrozenInstanceError):
+        a.name = "x"
+
+
+def test_override_scalar_and_nested():
+    cfg = get_config("mnist_mlp")
+    cfg2 = apply_overrides(
+        cfg, ["optimizer.learning_rate=0.5", "trainer.total_steps=7", "name=zz"]
+    )
+    assert cfg2.optimizer.learning_rate == 0.5
+    assert cfg2.trainer.total_steps == 7
+    assert cfg2.name == "zz"
+    # original untouched
+    assert cfg.trainer.total_steps != 7
+
+
+def test_override_types():
+    cfg = get_config("mnist_mlp")
+    cfg2 = apply_overrides(
+        cfg,
+        [
+            "model.hidden_sizes=128,64",
+            "checkpoint.enabled=true",
+            "optimizer.grad_clip_norm=none",
+            "mesh.data=4",
+        ],
+    )
+    assert cfg2.model.hidden_sizes == (128, 64)
+    assert cfg2.checkpoint.enabled is True
+    assert cfg2.optimizer.grad_clip_norm is None
+    assert cfg2.mesh.data == 4
+
+
+def test_override_unknown_field_raises():
+    cfg = get_config("mnist_mlp")
+    with pytest.raises(KeyError):
+        apply_overrides(cfg, ["trainer.nonexistent=1"])
+
+
+def test_roundtrip_dict():
+    cfg = get_config("gpt2_medium_zero1")
+    d = config_to_dict(cfg)
+    assert d["model"]["num_layers"] == 24
+    cfg2 = config_from_dict(ExperimentConfig, d)
+    assert cfg2.trainer == cfg.trainer
+    assert cfg2.optimizer == cfg.optimizer
+
+
+def test_mlp_default():
+    m = MLPConfig()
+    assert m.family == "mlp"

@@ -1,0 +1,135 @@
+"""Mesh + collectives tests on the simulated 8-device CPU mesh (SURVEY §4)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+from frl_distributed_ml_scaffold_tpu.config.schema import MeshConfig
+from frl_distributed_ml_scaffold_tpu.dist import build_mesh, collectives, local_batch_size
+from frl_distributed_ml_scaffold_tpu.dist.mesh import AXES, resolve_axis_sizes
+
+
+def test_eight_sim_devices():
+    assert jax.device_count() == 8
+
+
+def test_resolve_axis_sizes_wildcard():
+    sizes = resolve_axis_sizes(MeshConfig(data=-1, model=2), 8)
+    assert sizes["data"] == 4 and sizes["model"] == 2
+
+
+def test_resolve_axis_sizes_mismatch_raises():
+    with pytest.raises(ValueError):
+        resolve_axis_sizes(MeshConfig(data=3, model=2), 8)
+
+
+def test_build_mesh_axes_and_batch_spec():
+    env = build_mesh(MeshConfig(data=2, fsdp=2, model=2))
+    assert env.mesh.axis_names == AXES
+    assert env.num_devices == 8
+    assert env.batch_axis_size == 4
+    assert env.batch_spec(None) == P(("data", "fsdp"), None)
+
+
+def test_local_batch_size_single_process():
+    env = build_mesh(MeshConfig(data=-1))
+    assert local_batch_size(64, env) == 64
+    with pytest.raises(ValueError):
+        local_batch_size(12, env)  # not divisible by 8 batch devices
+
+
+def _shmap(fn, mesh, in_specs, out_specs):
+    return jax.jit(
+        shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    )
+
+
+def test_all_reduce_matches_sum():
+    env = build_mesh(MeshConfig(data=-1))
+    x = jnp.arange(8.0)
+
+    f = _shmap(
+        lambda a: collectives.all_reduce(a, "data"),
+        env.mesh, (P("data"),), P("data"),
+    )
+    out = f(x)
+    np.testing.assert_allclose(np.asarray(out), np.full(8, x.sum()))
+
+
+def test_all_mean_is_ddp_grad_average():
+    env = build_mesh(MeshConfig(data=-1))
+    x = jnp.arange(8.0)
+    f = _shmap(
+        lambda a: collectives.all_mean(a, "data"),
+        env.mesh, (P("data"),), P("data"),
+    )
+    np.testing.assert_allclose(np.asarray(f(x)), np.full(8, x.mean()))
+
+
+def test_all_gather_reduce_scatter_roundtrip():
+    env = build_mesh(MeshConfig(data=-1))
+    x = jnp.arange(16.0).reshape(8, 2)
+
+    def fn(a):  # a: (1, 2) shard
+        full = collectives.all_gather(a, "data")  # (8, 2)
+        return collectives.reduce_scatter(full, "data")  # (1, 2), sum over 8 copies
+
+    f = _shmap(fn, env.mesh, (P("data", None),), P("data", None))
+    np.testing.assert_allclose(np.asarray(f(x)), np.asarray(x) * 8)
+
+
+def test_broadcast_from_nonzero_source():
+    env = build_mesh(MeshConfig(data=-1))
+    x = jnp.arange(8.0)
+    f = _shmap(
+        lambda a: collectives.broadcast(a, "data", source=3),
+        env.mesh, (P("data"),), P("data"),
+    )
+    np.testing.assert_allclose(np.asarray(f(x)), np.full(8, 3.0))
+
+
+def test_ring_shift_rotates():
+    env = build_mesh(MeshConfig(data=-1))
+    x = jnp.arange(8.0)
+    f = _shmap(
+        lambda a: collectives.ring_shift(a, "data", shift=1),
+        env.mesh, (P("data"),), P("data"),
+    )
+    # shard i's value moves to shard i+1
+    np.testing.assert_allclose(np.asarray(f(x)), np.roll(np.arange(8.0), 1))
+
+
+def test_all_to_all_transposes_shards():
+    env = build_mesh(MeshConfig(data=-1))
+    x = jnp.arange(64.0).reshape(8, 8)
+
+    f = _shmap(
+        lambda a: collectives.all_to_all(a, "data", split_axis=1, concat_axis=0),
+        env.mesh, (P("data", None),), P(None, "data"),
+    )
+    out = f(x)
+    # all_to_all along the other axis is a block transpose of the shard grid;
+    # the global result here equals the original matrix re-tiled — check shape
+    # and content preservation.
+    assert out.shape == (8, 8)
+    assert set(np.asarray(out).ravel()) == set(np.arange(64.0))
+
+
+def test_axis_index_and_size():
+    env = build_mesh(MeshConfig(data=-1))
+
+    def fn(a):
+        return a + collectives.axis_index("data") * 0 + collectives.axis_size("data")
+
+    f = _shmap(fn, env.mesh, (P("data"),), P("data"))
+    np.testing.assert_allclose(np.asarray(f(jnp.zeros(8))), np.full(8, 8.0))
+
+
+def test_host_tier_single_process():
+    assert collectives.host_all_gather(np.array([1.0]))[0] == 1.0
+    collectives.barrier("test")
