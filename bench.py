@@ -33,12 +33,24 @@ def bench_config(name: str, overrides: list[str], *, steps: int, warmup: int):
     cfg = apply_overrides(get_config(name), overrides)
     trainer = Trainer(cfg)
     state = trainer.init_state()
+    # One device-resident batch, reused: the benchmark measures the chip
+    # (fwd+bwd+update), not the host loader (BASELINE.md protocol).
+    batch = trainer.pipeline.global_batch(0)
+    # Windowed timing: sync on the loss once per window, steps inside a
+    # window pipeline as in a real training loop (per-step syncs would
+    # charge the host<->device round-trip latency to every step).
+    # ``warmup`` counts windows (the first ones contain compile + ramp).
+    window = 5
+    n_windows = max(1, -(-steps // window))  # ceil; at least one measured
     timer = StepTimer(warmup=warmup)
-    for step in range(steps + warmup + 1):
-        batch = trainer.pipeline.global_batch(step)
-        state, metrics = trainer.train_step(state, batch)
-        timer.tick(metrics["loss"])
-    return timer.summary(cfg.data.global_batch_size)
+    for _ in range(n_windows + warmup + 1):
+        for _ in range(window):
+            state, metrics = trainer.train_step(state, batch)
+        timer.tick_window(metrics["loss"], window)
+    perf = timer.summary(cfg.data.global_batch_size)
+    if "samples_per_sec_per_chip" not in perf:
+        raise RuntimeError(f"benchmark produced no timed windows: {perf}")
+    return perf
 
 
 def main() -> int:

@@ -19,6 +19,8 @@ first-class even though the reference configs don't name them.
 
 from __future__ import annotations
 
+import dataclasses
+
 from frl_distributed_ml_scaffold_tpu.config.registry import register_config
 from frl_distributed_ml_scaffold_tpu.config.schema import (
     CheckpointConfig,
@@ -165,6 +167,8 @@ def gpt2_ring() -> ExperimentConfig:
         data=DataConfig(name="lm_synthetic", global_batch_size=8, seq_len=8192),
         mesh=MeshConfig(data=-1, seq=4),
         parallel=ParallelConfig(param_sharding="replicated", sequence="ring"),
+        # Long context already divides the batch finely; no microbatching.
+        trainer=dataclasses.replace(base.trainer, grad_accum=1),
     )
 
 

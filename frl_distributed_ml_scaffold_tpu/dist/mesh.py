@@ -119,6 +119,47 @@ def build_mesh(cfg: MeshConfig, devices=None) -> MeshEnv:
     return MeshEnv(mesh=Mesh(dev_array, AXES), config=cfg)
 
 
+# ---------------------------------------------------------------------------
+# Current-mesh context: manual-collective ops (ring attention, Ulysses,
+# pipeline) embed shard_map regions inside the GSPMD-jitted step and need the
+# concrete Mesh at trace time. The Trainer sets this once at construction.
+# ---------------------------------------------------------------------------
+
+_CURRENT_ENV: MeshEnv | None = None
+
+
+def set_current_mesh(env: MeshEnv | None) -> None:
+    global _CURRENT_ENV
+    _CURRENT_ENV = env
+
+
+def current_mesh_env() -> MeshEnv | None:
+    return _CURRENT_ENV
+
+
+class mesh_context:
+    """Scoped mesh context: ``with mesh_context(env): ...``.
+
+    jit tracing is lazy, so the context must be live at *call* time of any
+    function whose trace embeds shard_map regions — the Trainer wraps each
+    compiled-step invocation, which keeps two coexisting Trainers with
+    different meshes from poisoning each other's traces.
+    """
+
+    def __init__(self, env: MeshEnv | None):
+        self.env = env
+        self._prev: MeshEnv | None = None
+
+    def __enter__(self):
+        self._prev = current_mesh_env()
+        set_current_mesh(self.env)
+        return self.env
+
+    def __exit__(self, *exc):
+        set_current_mesh(self._prev)
+        return False
+
+
 def local_batch_size(global_batch_size: int, env: MeshEnv | None = None) -> int:
     """Per-host batch share (reference: per-rank batch). Validates evenness."""
     n_proc = jax.process_count()

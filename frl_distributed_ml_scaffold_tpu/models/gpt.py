@@ -1,0 +1,179 @@
+"""GPT-2 transformer LM (BASELINE config 4: GPT-2-medium, ZeRO-1 + accum).
+
+The flagship model and the carrier for every task-required parallelism
+(SURVEY C6–C9):
+
+- **TP**: q/k/v/fc_in kernels column-split, out/fc_out row-split over the
+  ``model`` axis — Megatron layout, expressed purely as ``gpt_tp_rules()``
+  regex → PartitionSpec (the model code itself is strategy-free; GSPMD
+  inserts the per-layer allreduces).
+- **SP**: ``attention="ring"`` routes through the ring-attention op
+  (ops/ring_attention.py) for sequence-sharded long context;
+  ``"ulysses"`` does the all_to_all head↔seq reshard around dense attention.
+- **EP**: ``moe.num_experts > 0`` swaps the MLP for the expert-parallel MoE
+  block (models/moe.py).
+
+TPU-first details: layers stacked with ``nn.scan`` (one compiled block body
+regardless of depth — compile time stays flat at 24 layers), softmax and
+LayerNorm in fp32, everything else in the policy compute dtype (bf16 on the
+MXU), weight-tied LM head.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from frl_distributed_ml_scaffold_tpu.config.schema import GPTConfig
+from frl_distributed_ml_scaffold_tpu.parallel.partition import PartitionRules
+from frl_distributed_ml_scaffold_tpu.precision import Policy
+
+
+def gpt_tp_rules() -> PartitionRules:
+    """Megatron column/row sharding (SURVEY C6). Kernels carry a leading
+    layer dim from nn.scan stacking, hence the extra ``None``."""
+    return PartitionRules(
+        rules=(
+            (r"blocks/attn/(query|key|value)/kernel", P(None, None, "model")),
+            (r"blocks/attn/(query|key|value)/bias", P(None, "model")),
+            (r"blocks/attn/out/kernel", P(None, "model", None)),
+            (r"blocks/mlp/fc_in/kernel", P(None, None, "model")),
+            (r"blocks/mlp/fc_in/bias", P(None, "model")),
+            (r"blocks/mlp/fc_out/kernel", P(None, "model", None)),
+            (r"blocks/moe/wi", P(None, "expert", None, "model")),
+            (r"blocks/moe/wo", P(None, "expert", "model", None)),
+            (r"blocks/moe/router/kernel", P(None, None, None)),
+            (r"wte/embedding", P("model", None)),
+        )
+    )
+
+
+class CausalSelfAttention(nn.Module):
+    config: GPTConfig
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, train: bool) -> jnp.ndarray:
+        cfg = self.config
+        d = cfg.hidden_dim
+        h = cfg.num_heads
+        hd = d // h
+        q = nn.Dense(d, dtype=self.dtype, name="query")(x)
+        k = nn.Dense(d, dtype=self.dtype, name="key")(x)
+        v = nn.Dense(d, dtype=self.dtype, name="value")(x)
+        b, t, _ = x.shape
+        q = q.reshape(b, t, h, hd)
+        k = k.reshape(b, t, h, hd)
+        v = v.reshape(b, t, h, hd)
+
+        if cfg.attention == "ring":
+            from frl_distributed_ml_scaffold_tpu.ops.ring_attention import (
+                ring_attention,
+            )
+
+            y = ring_attention(q, k, v, axis_name="seq", causal=True)
+        elif cfg.attention == "ulysses":
+            from frl_distributed_ml_scaffold_tpu.ops.ulysses import (
+                ulysses_attention,
+            )
+
+            y = ulysses_attention(q, k, v, axis_name="seq", causal=True)
+        else:  # "dense" | "flash" (flash kernel lands in ops/, falls back)
+            y = _dense_causal_attention(q, k, v)
+
+        y = y.reshape(b, t, d)
+        y = nn.Dense(d, dtype=self.dtype, name="out")(y)
+        y = nn.Dropout(cfg.dropout, deterministic=not train)(y)
+        return y
+
+
+def _dense_causal_attention(q, k, v):
+    """Reference attention: fp32 softmax, static causal mask."""
+    b, t, h, hd = q.shape
+    scale = 1.0 / np.sqrt(hd)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    logits = logits.astype(jnp.float32)
+    mask = jnp.tril(jnp.ones((t, t), bool))
+    logits = jnp.where(mask[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+class GptMlp(nn.Module):
+    config: GPTConfig
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, train: bool) -> jnp.ndarray:
+        cfg = self.config
+        y = nn.Dense(cfg.hidden_dim * cfg.mlp_ratio, dtype=self.dtype, name="fc_in")(x)
+        y = nn.gelu(y)
+        y = nn.Dense(cfg.hidden_dim, dtype=self.dtype, name="fc_out")(y)
+        y = nn.Dropout(cfg.dropout, deterministic=not train)(y)
+        return y
+
+
+class Block(nn.Module):
+    config: GPTConfig
+    dtype: Any
+    train: bool  # static per-trace; bound at GPT.__call__ construction time
+
+    @nn.compact
+    def __call__(self, carry, _unused):
+        x, aux_loss = carry
+        cfg, train = self.config, self.train
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        x = x + CausalSelfAttention(cfg, self.dtype, name="attn")(y, train=train)
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        if cfg.moe.num_experts > 0:
+            from frl_distributed_ml_scaffold_tpu.models.moe import MoEMlp
+
+            mlp_out, layer_aux = MoEMlp(cfg, self.dtype, name="moe")(y, train=train)
+            aux_loss = aux_loss + layer_aux
+        else:
+            mlp_out = GptMlp(cfg, self.dtype, name="mlp")(y, train=train)
+        x = x + mlp_out
+        return (x, aux_loss), None
+
+
+class GPT(nn.Module):
+    config: GPTConfig
+    policy: Policy
+
+    @nn.compact
+    def __call__(self, tokens: jnp.ndarray, *, train: bool = False):
+        cfg = self.config
+        dtype = self.policy.compute_dtype
+        b, t = tokens.shape
+
+        wte = nn.Embed(
+            cfg.vocab_size,
+            cfg.hidden_dim,
+            dtype=dtype,
+            embedding_init=nn.initializers.normal(stddev=0.02),
+            name="wte",
+        )
+        wpe = self.param(
+            "wpe", nn.initializers.normal(stddev=0.02), (cfg.seq_len, cfg.hidden_dim)
+        )
+        x = wte(tokens) + wpe[:t].astype(dtype)
+        x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
+
+        blocks = nn.scan(
+            Block,
+            length=cfg.num_layers,
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "dropout": True},
+        )(cfg, dtype, train, name="blocks")
+        (x, aux_loss), _ = blocks((x, jnp.zeros((), jnp.float32)), None)
+
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        logits = wte.attend(x.astype(dtype))  # weight-tied LM head
+        if cfg.moe.num_experts > 0:
+            return logits, aux_loss
+        return logits

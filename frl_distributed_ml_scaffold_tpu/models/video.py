@@ -1,0 +1,58 @@
+"""Video-clip classifier (BASELINE config 5: the Ego4D-style recipe).
+
+Tubelet-ViT (ViViT-style): a 3D conv embeds (t, h, w) tubelets of the clip
+into tokens — one big MXU matmul, same as the ViT patch conv but with a time
+dimension — then a standard pre-LN transformer over the spatio-temporal
+token sequence with mean pooling. Reuses the ViT encoder blocks.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from frl_distributed_ml_scaffold_tpu.config.schema import VideoConfig
+from frl_distributed_ml_scaffold_tpu.models.vit import EncoderBlock
+from frl_distributed_ml_scaffold_tpu.precision import Policy
+
+
+class VideoClassifier(nn.Module):
+    config: VideoConfig
+    policy: Policy
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
+        cfg = self.config
+        dtype = self.policy.compute_dtype
+        x = x.astype(dtype)  # (B, T, H, W, C)
+        tt, th, tw = cfg.tubelet_size
+        x = nn.Conv(
+            cfg.hidden_dim,
+            kernel_size=(tt, th, tw),
+            strides=(tt, th, tw),
+            padding="VALID",
+            dtype=dtype,
+        )(x)  # (B, T', H', W', D)
+        b = x.shape[0]
+        x = x.reshape(b, -1, cfg.hidden_dim)
+
+        pos = self.param(
+            "pos_embedding",
+            nn.initializers.normal(stddev=0.02),
+            (1, x.shape[1], cfg.hidden_dim),
+        )
+        x = x + pos.astype(dtype)
+        x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
+
+        for _ in range(cfg.num_layers):
+            x = EncoderBlock(
+                num_heads=cfg.num_heads,
+                mlp_ratio=cfg.mlp_ratio,
+                dropout=cfg.dropout,
+                dtype=dtype,
+            )(x, train=train)
+
+        x = nn.LayerNorm(dtype=jnp.float32)(x)
+        x = jnp.mean(x, axis=1)
+        x = nn.Dense(cfg.num_classes, dtype=dtype)(x)
+        return x.astype(self.policy.output_dtype)

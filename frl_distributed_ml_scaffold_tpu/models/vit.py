@@ -1,0 +1,103 @@
+"""ViT-B/16 (BASELINE config 3: ImageNet FSDP + activation checkpointing).
+
+Pre-LN ViT. TPU-first: patch embedding as a strided conv (one big MXU-
+friendly matmul), bf16 compute with fp32 LayerNorm, learned position
+embeddings, CLS or mean pooling. FSDP sharding comes entirely from the
+partitioning layer (no wrapper) and remat from the trainer config — the
+model itself stays strategy-agnostic.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from frl_distributed_ml_scaffold_tpu.config.schema import ViTConfig
+from frl_distributed_ml_scaffold_tpu.precision import Policy
+
+
+class MlpBlock(nn.Module):
+    dim: int
+    mlp_ratio: int
+    dropout: float
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        y = nn.Dense(self.dim * self.mlp_ratio, dtype=self.dtype)(x)
+        y = nn.gelu(y)
+        y = nn.Dropout(self.dropout, deterministic=not train)(y)
+        y = nn.Dense(self.dim, dtype=self.dtype)(y)
+        y = nn.Dropout(self.dropout, deterministic=not train)(y)
+        return y
+
+
+class EncoderBlock(nn.Module):
+    num_heads: int
+    mlp_ratio: int
+    dropout: float
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        dim = x.shape[-1]
+        y = nn.LayerNorm(dtype=jnp.float32)(x)
+        y = nn.MultiHeadDotProductAttention(
+            num_heads=self.num_heads,
+            dtype=self.dtype,
+            dropout_rate=self.dropout,
+            deterministic=not train,
+        )(y, y)
+        x = x + y
+        y = nn.LayerNorm(dtype=jnp.float32)(x)
+        y = MlpBlock(
+            dim=dim, mlp_ratio=self.mlp_ratio, dropout=self.dropout, dtype=self.dtype
+        )(y, train=train)
+        return x + y
+
+
+class ViT(nn.Module):
+    config: ViTConfig
+    policy: Policy
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
+        cfg = self.config
+        dtype = self.policy.compute_dtype
+        x = x.astype(dtype)
+        p = cfg.patch_size
+        # Patch embedding: strided conv == per-patch linear proj, MXU-shaped.
+        x = nn.Conv(
+            cfg.hidden_dim, (p, p), strides=(p, p), padding="VALID", dtype=dtype
+        )(x)
+        b, h, w, c = x.shape
+        x = x.reshape(b, h * w, c)
+
+        if cfg.pool == "cls":
+            cls = self.param(
+                "cls_token", nn.initializers.zeros, (1, 1, cfg.hidden_dim)
+            )
+            x = jnp.concatenate([jnp.tile(cls, (b, 1, 1)).astype(dtype), x], axis=1)
+
+        pos = self.param(
+            "pos_embedding",
+            nn.initializers.normal(stddev=0.02),
+            (1, x.shape[1], cfg.hidden_dim),
+        )
+        x = x + pos.astype(dtype)
+        x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
+
+        for _ in range(cfg.num_layers):
+            x = EncoderBlock(
+                num_heads=cfg.num_heads,
+                mlp_ratio=cfg.mlp_ratio,
+                dropout=cfg.dropout,
+                dtype=dtype,
+            )(x, train=train)
+
+        x = nn.LayerNorm(dtype=jnp.float32)(x)
+        x = x[:, 0] if cfg.pool == "cls" else jnp.mean(x, axis=1)
+        x = nn.Dense(cfg.num_classes, dtype=dtype)(x)
+        return x.astype(self.policy.output_dtype)

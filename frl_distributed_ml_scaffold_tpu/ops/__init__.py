@@ -1,0 +1,19 @@
+"""TPU ops layer (SURVEY C8 + §5 long-context; Pallas kernels).
+
+Manual-parallelism attention implementations that GSPMD cannot derive from
+sharding annotations alone:
+
+- ``ring_attention`` — blockwise-softmax attention with the KV shards
+  rotating around the ``seq`` mesh axis via ``ppermute`` (ring/blockwise
+  attention; PAPERS.md collective-redistribution lineage).
+- ``ulysses_attention`` — DeepSpeed-Ulysses-style ``all_to_all`` reshard
+  (seq-sharded ↔ head-sharded) around ordinary dense attention.
+- ``flash_attention`` — fused blockwise attention Pallas kernel for the MXU
+  (ops/pallas/).
+
+All are drop-in (B, T, H, D)-shaped attention functions used by the GPT
+model's ``attention=`` config switch.
+"""
+
+from frl_distributed_ml_scaffold_tpu.ops.ring_attention import ring_attention
+from frl_distributed_ml_scaffold_tpu.ops.ulysses import ulysses_attention

@@ -1,0 +1,110 @@
+"""Ring attention: sequence-parallel causal attention over the ``seq`` axis.
+
+SURVEY C8 / §5. The sequence dimension is sharded across the ``seq`` mesh
+axis; each shard keeps its queries resident while the K/V shards rotate
+around the ring via ``ppermute`` (one neighbor hop per step — this is what
+rides the ICI torus links). Softmax is computed online (flash-attention
+style running max/denominator rescaling), so no shard ever materializes the
+full [T, T] score matrix — memory stays O(T_local²·heads) and context
+length scales linearly with the ring size.
+
+Numerics: logits/accumulators in fp32, output cast back to the input dtype;
+fully-masked blocks contribute nothing (mask applied to probabilities, not
+only logits, so the -1e30 sentinel can't leak through the running max).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from frl_distributed_ml_scaffold_tpu.dist.mesh import BATCH_AXES, current_mesh_env
+
+_NEG_INF = -1.0e30
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = "seq",
+    causal: bool = True,
+) -> jax.Array:
+    """(B, T, H, D) attention with T sharded over ``axis_name``.
+
+    Called from model code tracing under the GSPMD jit; wraps its own
+    shard_map region over the current mesh. Falls back to single-device
+    blockwise math when the seq axis is trivial.
+    """
+    env = current_mesh_env()
+    if env is None or env.axis_size(axis_name) == 1:
+        return _single_shard_attention(q, k, v, causal=causal)
+
+    spec = P(BATCH_AXES, axis_name, "model", None)
+    inner = partial(_ring_shard_fn, axis_name=axis_name, causal=causal)
+    return jax.shard_map(
+        inner,
+        mesh=env.mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )(q, k, v)
+
+
+def _ring_shard_fn(q, k, v, *, axis_name: str, causal: bool):
+    idx = lax.axis_index(axis_name)
+    n = lax.axis_size(axis_name)
+    b, t_local, h, d = q.shape
+    scale = 1.0 / np.sqrt(d)
+    q32 = q.astype(jnp.float32) * scale
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(s, carry):
+        k_blk, v_blk, m, l, acc = carry
+        # After s rotations this shard holds the block originally at idx - s.
+        src = (idx - s) % n
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q32, k_blk.astype(jnp.float32))
+        if causal:
+            qpos = idx * t_local + jnp.arange(t_local)[:, None]
+            kpos = src * t_local + jnp.arange(t_local)[None, :]
+            mask = (qpos >= kpos)[None, None]
+        else:
+            mask = jnp.ones((1, 1, t_local, t_local), bool)
+        logits = jnp.where(mask, logits, _NEG_INF)
+        m_new = jnp.maximum(m, logits.max(axis=-1))
+        p = jnp.exp(logits - m_new[..., None]) * mask  # mask kills sentinels
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + p.sum(axis=-1)
+        acc_new = acc * alpha.transpose(0, 2, 1)[..., None] + jnp.einsum(
+            "bhqk,bkhd->bqhd", p, v_blk.astype(jnp.float32)
+        )
+        k_nxt, v_nxt = lax.ppermute((k_blk, v_blk), axis_name, perm)
+        return (k_nxt, v_nxt, m_new, l_new, acc_new)
+
+    m0 = jnp.full((b, h, t_local), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, t_local), jnp.float32)
+    acc0 = jnp.zeros((b, t_local, h, d), jnp.float32)
+    _, _, _, l, acc = lax.fori_loop(0, n, body, (k, v, m0, l0, acc0))
+    denom = jnp.maximum(l, 1e-20).transpose(0, 2, 1)[..., None]
+    return (acc / denom).astype(q.dtype)
+
+
+def _single_shard_attention(q, k, v, *, causal: bool):
+    """Dense fallback with identical numerics contract (fp32 softmax)."""
+    b, t, h, d = q.shape
+    scale = 1.0 / np.sqrt(d)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale,
+                        k.astype(jnp.float32))
+    if causal:
+        mask = jnp.tril(jnp.ones((t, t), bool))[None, None]
+        logits = jnp.where(mask, logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)

@@ -59,6 +59,10 @@ def fsdp_spec_for(
     if axis_size <= 1 or int(np.prod(shape)) < min_size:
         return base
     entries = list(base) + [None] * (len(shape) - len(base))
+    # Already sharded over this axis (e.g. ZeRO-1 overlay on FSDP params):
+    # nothing to add — a mesh axis can appear at most once in a spec.
+    if any(axis == e or (isinstance(e, tuple) and axis in e) for e in entries):
+        return P(*entries)
     candidates = [
         i
         for i, (dim, e) in enumerate(zip(shape, entries))

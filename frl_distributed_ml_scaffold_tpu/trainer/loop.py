@@ -82,31 +82,54 @@ class Trainer:
     # ---------------------------------------------------------------- setup
 
     def _init_state_fn(self, rng):
-        x = example_input(self.cfg.data, self.cfg.model)
+        x = example_input(
+            self.cfg.data, self.cfg.model, batch_size=self.env.batch_axis_size
+        )
         key = "tokens" if "tokens" in x else ("video" if "video" in x else "image")
         inp = jnp.asarray(x[key][:, :-1] if key == "tokens" else x[key])
-        variables = self.model.init({"params": rng}, inp, train=False)
-        return TrainState.create(variables["params"], self.tx)
+        variables = dict(self.model.init({"params": rng}, inp, train=False))
+        params = variables.pop("params")
+        return TrainState.create(params, self.tx, extras=variables)
 
     def _build_state_shardings(self) -> None:
         cfg, env = self.cfg, self.env
         rng = jax.random.key(cfg.trainer.seed)
-        state_shapes = jax.eval_shape(self._init_state_fn, rng)
+        state_shapes = self._mesh_scoped(jax.eval_shape)(self._init_state_fn, rng)
         rules = model_partition_rules(cfg.model, env)
         p_specs = param_specs(state_shapes.params, cfg.parallel, env.mesh, rules)
         o_specs = opt_state_specs(
             state_shapes.opt_state, state_shapes.params, p_specs, cfg.parallel, env.mesh
         )
-        self.state_specs = TrainState(step=P(), params=p_specs, opt_state=o_specs)
+        # Non-param collections (BatchNorm stats etc.) are small — replicate.
+        e_specs = jax.tree.map(lambda _: P(), state_shapes.extras)
+        self.state_specs = TrainState(
+            step=P(), params=p_specs, opt_state=o_specs, extras=e_specs
+        )
         self.state_shardings = shardings_from_specs(self.state_specs, env.mesh)
         self.state_shapes = state_shapes
         self._rng = rng
 
+    def _mesh_scoped(self, fn):
+        """Run ``fn`` with this trainer's mesh as the ambient context.
+
+        Tracing is lazy — the context must hold when a compiled fn first
+        traces (ring/Ulysses shard_map regions read it), not at Trainer
+        construction, or two Trainers with different meshes would poison
+        each other's traces.
+        """
+        from frl_distributed_ml_scaffold_tpu.dist.mesh import mesh_context
+
+        def wrapped(*args, **kwargs):
+            with mesh_context(self.env):
+                return fn(*args, **kwargs)
+
+        return wrapped
+
     def init_state(self) -> TrainState:
         """Initialize the train state directly into its shardings."""
-        state = jax.jit(self._init_state_fn, out_shardings=self.state_shardings)(
-            self._rng
-        )
+        state = self._mesh_scoped(
+            jax.jit(self._init_state_fn, out_shardings=self.state_shardings)
+        )(self._rng)
         n_params = tree_param_count(state.params)
         self.logger.info(
             "initialized %s: %.2fM params over mesh %s",
@@ -132,17 +155,19 @@ class Trainer:
             remat=cfg.trainer.remat,
         )
         # Batch shardings are inferred from the example batch structure.
-        example = example_input(cfg.data, cfg.model)
+        example = example_input(cfg.data, cfg.model, batch_size=self.env.batch_axis_size)
         batch_sh = self._batch_shardings(example)
-        self.train_step = jax.jit(
-            step_fn,
-            in_shardings=(self.state_shardings, batch_sh),
-            out_shardings=(self.state_shardings, None),
-            donate_argnums=(0,),
+        self.train_step = self._mesh_scoped(
+            jax.jit(
+                step_fn,
+                in_shardings=(self.state_shardings, batch_sh),
+                out_shardings=(self.state_shardings, None),
+                donate_argnums=(0,),
+            )
         )
         eval_fn = make_eval_step(self.loss_fn, self.policy, seed=cfg.trainer.seed)
-        self.eval_step = jax.jit(
-            eval_fn, in_shardings=(self.state_shardings, batch_sh)
+        self.eval_step = self._mesh_scoped(
+            jax.jit(eval_fn, in_shardings=(self.state_shardings, batch_sh))
         )
 
     # ----------------------------------------------------------------- loop

@@ -1,8 +1,10 @@
 """Task loss functions: the glue between models and the compiled step.
 
-``make_loss_fn`` returns the ``loss_fn(params, batch, rng, train)``
-contract that train_step.py consumes. Loss math runs in fp32 regardless of
-compute dtype (softmax/CE in bf16 loses too much precision).
+``make_loss_fn`` returns the ``loss_fn(params, extras, batch, rng, train)``
+contract that train_step.py consumes (``extras`` = non-param variable
+collections like BatchNorm stats; ``{}`` for stateless models). Loss math
+runs in fp32 regardless of compute dtype (softmax/CE in bf16 loses too much
+precision).
 """
 
 from __future__ import annotations
@@ -14,19 +16,28 @@ import jax.numpy as jnp
 import optax
 
 
-def _apply(model, params, x, rng, train: bool):
+def _apply(model, params, extras, x, rng, train: bool):
+    """Apply with mutable non-param collections in train mode."""
+    variables = {"params": params, **extras}
     rngs = {"dropout": rng} if train else None
-    return model.apply({"params": params}, x, train=train, rngs=rngs)
+    mutable = list(extras.keys()) if (train and extras) else False
+    out = model.apply(variables, x, train=train, rngs=rngs, mutable=mutable)
+    if mutable:
+        y, new_extras = out
+        return y, dict(new_extras)
+    return out, extras
 
 
 def make_classification_loss(model, input_key: str = "image"):
-    def loss_fn(params, batch, rng, train):
-        logits = _apply(model, params, batch[input_key], rng, train)
+    def loss_fn(params, extras, batch, rng, train):
+        logits, new_extras = _apply(
+            model, params, extras, batch[input_key], rng, train
+        )
         logits = logits.astype(jnp.float32)
         labels = batch["label"]
         loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
         acc = (jnp.argmax(logits, -1) == labels).astype(jnp.float32).mean()
-        return loss, {"accuracy": acc}
+        return loss, ({"accuracy": acc}, new_extras)
 
     return loss_fn
 
@@ -34,10 +45,10 @@ def make_classification_loss(model, input_key: str = "image"):
 def make_lm_loss(model):
     """Next-token CE over ``batch["tokens"]`` (shape [B, L+1])."""
 
-    def loss_fn(params, batch, rng, train):
+    def loss_fn(params, extras, batch, rng, train):
         tokens = batch["tokens"]
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
-        out = _apply(model, params, inputs, rng, train)
+        out, new_extras = _apply(model, params, extras, inputs, rng, train)
         # MoE models return (logits, aux_loss); dense return logits.
         aux_loss = jnp.zeros((), jnp.float32)
         if isinstance(out, tuple):
@@ -50,7 +61,7 @@ def make_lm_loss(model):
         metrics = {"ce_loss": ce, "perplexity": jnp.exp(ce)}
         if isinstance(out, tuple):
             metrics["aux_loss"] = aux_loss
-        return loss, metrics
+        return loss, (metrics, new_extras)
 
     return loss_fn
 
@@ -65,24 +76,29 @@ def make_loss_fn(model, data_name: str):
     raise KeyError(f"no task for dataset {data_name!r}")
 
 
-def example_input(data_cfg, model_cfg) -> dict[str, Any]:
-    """A single-element batch for model init/shape inference."""
+def example_input(data_cfg, model_cfg, batch_size: int = 1) -> dict[str, Any]:
+    """A tiny batch for model init/shape inference.
+
+    ``batch_size`` must divide over the mesh batch axes when the model embeds
+    shard_map regions (ring/Ulysses attention) — the Trainer passes the mesh
+    batch-axis size.
+    """
     import numpy as np
 
     name = data_cfg.name
     if name in ("mnist", "synthetic_mnist", "imagenet", "synthetic_imagenet"):
         return {
             "image": np.zeros(
-                (1, data_cfg.image_size, data_cfg.image_size, data_cfg.channels),
+                (batch_size, data_cfg.image_size, data_cfg.image_size, data_cfg.channels),
                 np.float32,
             ),
-            "label": np.zeros((1,), np.int32),
+            "label": np.zeros((batch_size,), np.int32),
         }
     if name in ("video", "video_synthetic"):
         return {
             "video": np.zeros(
                 (
-                    1,
+                    batch_size,
                     data_cfg.num_frames,
                     data_cfg.image_size,
                     data_cfg.image_size,
@@ -90,8 +106,8 @@ def example_input(data_cfg, model_cfg) -> dict[str, Any]:
                 ),
                 np.float32,
             ),
-            "label": np.zeros((1,), np.int32),
+            "label": np.zeros((batch_size,), np.int32),
         }
     if name in ("lm", "lm_synthetic"):
-        return {"tokens": np.zeros((1, data_cfg.seq_len + 1), np.int32)}
+        return {"tokens": np.zeros((batch_size, data_cfg.seq_len + 1), np.int32)}
     raise KeyError(f"no example input for dataset {name!r}")

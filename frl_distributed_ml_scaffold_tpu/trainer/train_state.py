@@ -19,11 +19,21 @@ class TrainState(flax.struct.PyTreeNode):
     step: Any  # int32 scalar array
     params: Any
     opt_state: Any
+    # Non-parameter variable collections (e.g. BatchNorm ``batch_stats``).
+    # Under GSPMD these are logically global arrays, so BN statistics reduce
+    # over the *global* batch — sync-BN semantics with zero extra code.
+    extras: Any
 
     @classmethod
-    def create(cls, params: Any, tx: optax.GradientTransformation) -> "TrainState":
+    def create(
+        cls,
+        params: Any,
+        tx: optax.GradientTransformation,
+        extras: Any = None,
+    ) -> "TrainState":
         return cls(
             step=jnp.zeros((), jnp.int32),
             params=params,
             opt_state=tx.init(params),
+            extras={} if extras is None else extras,
         )

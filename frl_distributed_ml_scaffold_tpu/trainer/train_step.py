@@ -15,8 +15,13 @@ is a bug (SURVEY call stack (b)).
 - AMP (C10): params cast to the policy's compute dtype for fwd/bwd;
   gradients cast back to fp32 for the optimizer update.
 
-``loss_fn(params, batch, rng, train)`` → ``(loss, metrics_dict)`` is the
-only model-facing contract; recipes build it in trainer/tasks.py.
+The model-facing contract (built in trainer/tasks.py):
+
+    loss_fn(params, extras, batch, rng, train)
+        -> (loss, (metrics_dict, new_extras))
+
+``extras`` carries non-parameter variable collections (BatchNorm stats);
+models without any use ``{}``.
 """
 
 from __future__ import annotations
@@ -31,19 +36,19 @@ from jax import lax
 from frl_distributed_ml_scaffold_tpu.precision import Policy
 from frl_distributed_ml_scaffold_tpu.trainer.train_state import TrainState
 
-LossFn = Callable[..., tuple[jax.Array, dict[str, jax.Array]]]
+LossFn = Callable[..., tuple[jax.Array, tuple[dict[str, jax.Array], Any]]]
 
 
 def _remat_wrap(loss_fn: LossFn, remat: str) -> LossFn:
     if remat == "none":
         return loss_fn
     if remat == "full":
-        return jax.checkpoint(loss_fn, static_argnums=(3,))
+        return jax.checkpoint(loss_fn, static_argnums=(4,))
     if remat == "dots":
         return jax.checkpoint(
             loss_fn,
             policy=jax.checkpoint_policies.checkpoint_dots,
-            static_argnums=(3,),
+            static_argnums=(4,),
         )
     raise KeyError(f"unknown remat mode {remat!r}")
 
@@ -66,11 +71,13 @@ def make_train_step(
     wrapped = _remat_wrap(loss_fn, remat)
     grad_fn = jax.value_and_grad(wrapped, has_aux=True)
 
-    def single(params_c, batch, rng):
-        (loss, metrics), grads = grad_fn(params_c, batch, rng, True)
-        return loss, metrics, grads
+    def single(params_c, extras, batch, rng):
+        (loss, (metrics, new_extras)), grads = grad_fn(
+            params_c, extras, batch, rng, True
+        )
+        return loss, metrics, new_extras, grads
 
-    def accumulated(params_c, batch, rng):
+    def accumulated(params_c, extras, batch, rng):
         def reshape(x):
             if x.shape[0] % grad_accum:
                 raise ValueError(
@@ -83,28 +90,34 @@ def make_train_step(
         zero_grads = jax.tree.map(
             lambda p: jnp.zeros(p.shape, policy.reduce_dtype), params_c
         )
+        first_micro = jax.tree.map(lambda x: x[0], micro)
+        metrics_shape = jax.eval_shape(
+            lambda: wrapped(params_c, extras, first_micro, rngs[0], True)[1][0]
+        )
+        zero_metrics = jax.tree.map(
+            lambda _: jnp.zeros((), jnp.float32), metrics_shape
+        )
 
         def body(carry, xs):
-            g_acc, l_acc, m_acc = carry
+            g_acc, l_acc, m_acc, ex = carry
             mb, r = xs
-            (loss, metrics), grads = grad_fn(params_c, mb, r, True)
+            (loss, (metrics, new_ex)), grads = grad_fn(params_c, ex, mb, r, True)
             g_acc = jax.tree.map(
                 lambda a, g: a + g.astype(policy.reduce_dtype), g_acc, grads
             )
             m_acc = jax.tree.map(lambda a, m: a + m, m_acc, metrics)
-            return (g_acc, l_acc + loss, m_acc), None
+            return (g_acc, l_acc + loss, m_acc, new_ex), None
 
-        zero_metrics = jax.tree.map(
-            lambda _: jnp.zeros((), jnp.float32),
-            jax.eval_shape(lambda: wrapped(params_c, jax.tree.map(lambda x: x[0], micro), rngs[0], True)[1])
-        )
-        (grads, loss, metrics), _ = lax.scan(
-            body, (zero_grads, jnp.zeros((), jnp.float32), zero_metrics), (micro, rngs)
+        (grads, loss, metrics, new_extras), _ = lax.scan(
+            body,
+            (zero_grads, jnp.zeros((), jnp.float32), zero_metrics, extras),
+            (micro, rngs),
         )
         inv = 1.0 / grad_accum
         return (
             loss * inv,
             jax.tree.map(lambda m: m * inv, metrics),
+            new_extras,
             jax.tree.map(lambda g: g * inv, grads),
         )
 
@@ -112,9 +125,13 @@ def make_train_step(
         rng = jax.random.fold_in(jax.random.key(seed), state.step)
         params_c = policy.cast_to_compute(state.params)
         if grad_accum > 1:
-            loss, metrics, grads = accumulated(params_c, batch, rng)
+            loss, metrics, new_extras, grads = accumulated(
+                params_c, state.extras, batch, rng
+            )
         else:
-            loss, metrics, grads = single(params_c, batch, rng)
+            loss, metrics, new_extras, grads = single(
+                params_c, state.extras, batch, rng
+            )
         grads = policy.cast_to_param(grads)
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
@@ -122,7 +139,10 @@ def make_train_step(
         out_metrics["loss"] = loss.astype(jnp.float32)
         out_metrics["grad_norm"] = optax.global_norm(grads).astype(jnp.float32)
         new_state = state.replace(
-            step=state.step + 1, params=new_params, opt_state=new_opt_state
+            step=state.step + 1,
+            params=new_params,
+            opt_state=new_opt_state,
+            extras=new_extras,
         )
         return new_state, out_metrics
 
@@ -135,7 +155,7 @@ def make_eval_step(loss_fn: LossFn, policy: Policy, *, seed: int = 0):
     def eval_fn(state: TrainState, batch: Any) -> dict[str, jax.Array]:
         rng = jax.random.fold_in(jax.random.key(seed + 1), state.step)
         params_c = policy.cast_to_compute(state.params)
-        loss, metrics = loss_fn(params_c, batch, rng, False)
+        loss, (metrics, _) = loss_fn(params_c, state.extras, batch, rng, False)
         out = dict(metrics)
         out["loss"] = loss.astype(jnp.float32)
         return out
