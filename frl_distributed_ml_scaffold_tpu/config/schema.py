@@ -132,6 +132,19 @@ class CheckpointConfig:
 
 
 @dataclass(frozen=True)
+class ElasticConfig:
+    """Checkpoint-restart elasticity (SURVEY C14): the supervisor restarts a
+    dead child up to ``max_restarts`` times with exponential backoff."""
+
+    max_restarts: int = 3
+    backoff_s: float = 1.0
+    # A child that survives this long before dying counts as real progress:
+    # the restart budget and backoff reset (torchrun-elastic-agent semantics),
+    # so a week-long run isn't killed by its 4th once-a-day preemption.
+    reset_after_s: float = 600.0
+
+
+@dataclass(frozen=True)
 class DataConfig:
     """Input pipeline selection (SURVEY C16). ``global_batch_size`` is the
     whole-run batch; the pipeline shards it per host and the mesh shards it
@@ -244,6 +257,7 @@ class ExperimentConfig:
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
     precision: PrecisionConfig = field(default_factory=PrecisionConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    elastic: ElasticConfig = field(default_factory=ElasticConfig)
     workdir: str = "/tmp/frl_tpu_runs"
 
     def replace(self, **kw) -> "ExperimentConfig":

@@ -1,0 +1,156 @@
+"""Elastic checkpoint-restart supervisor (SURVEY C14, call stack (d)).
+
+The reference's torchrun elastic agent detects worker death, re-rendezvouses
+the surviving/replacement nodes, and workers reload the last checkpoint. JAX
+has no in-band elasticity — membership is fixed at
+``jax.distributed.initialize`` — so the TPU-native design is deliberate
+**checkpoint-restart elasticity** (SURVEY C14): a per-host supervisor runs
+the training as a child process; when the child dies, the supervisor
+restarts it (fresh ``initialize``, possibly over a different topology) and
+the run resumes from the last Orbax checkpoint via the resharding restore
+path (checkpoint/manager.py). On a multi-host pod each host runs its own
+supervisor; the coordinator's supervisor restarting re-forms the cluster.
+
+Fault injection (SURVEY §5) lives here too: ``FRL_FAULT_AT_STEP=N`` makes
+the child hard-exit (``os._exit`` — no checkpoint flush, no atexit, the
+moral equivalent of SIGKILL) after completing step N, exactly once per
+workdir. The kill-and-resume test tier drives the supervisor through a real
+crash → restart → resume cycle.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from typing import Callable, Optional
+
+from frl_distributed_ml_scaffold_tpu.config.schema import ExperimentConfig
+from frl_distributed_ml_scaffold_tpu.utils.logging import get_logger
+
+#: Exit code the fault-injection hook dies with (distinguishable from real
+#: python tracebacks' rc=1 in supervisor logs).
+FAULT_EXIT_CODE = 43
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+# --------------------------------------------------------------------------
+# Supervisor (parent side)
+# --------------------------------------------------------------------------
+
+
+def _child_command(args) -> list[str]:
+    """Re-exec the launcher without --elastic, checkpointing forced on.
+
+    The forced overrides come last so they beat anything the user passed:
+    a supervised run without checkpoint+resume would restart from step 0
+    forever.
+    """
+    cmd = [
+        sys.executable,
+        "-m",
+        "frl_distributed_ml_scaffold_tpu.launcher.launch",
+        "--config",
+        args.config,
+        "--device",
+        args.device,
+    ]
+    if args.device == "cpu" and args.sim_devices:
+        cmd += ["--sim-devices", str(args.sim_devices)]
+    if args.coordinator:
+        cmd += ["--coordinator", args.coordinator]
+    if args.num_processes is not None:
+        cmd += ["--num-processes", str(args.num_processes)]
+    if args.process_id is not None:
+        cmd += ["--process-id", str(args.process_id)]
+    cmd += list(args.overrides)
+    cmd += ["checkpoint.enabled=true", "checkpoint.resume=true"]
+    return cmd
+
+
+def supervise(args, cfg: ExperimentConfig) -> int:
+    """Run the training child under restart supervision; returns final rc.
+
+    Restart policy: up to ``cfg.elastic.max_restarts`` restarts with
+    exponential backoff starting at ``cfg.elastic.backoff_s``. A clean child
+    exit (rc 0) ends supervision; exhausting the budget returns the child's
+    last rc.
+    """
+    logger = get_logger()
+    cmd = _child_command(args)
+    env = os.environ.copy()
+    env["PYTHONPATH"] = _REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    restarts = 0
+    logger.info("elastic: supervising %s", " ".join(cmd))
+    while True:
+        t0 = time.monotonic()
+        rc = subprocess.call(cmd, cwd=_REPO_ROOT, env=env)
+        elapsed = time.monotonic() - t0
+        if rc == 0:
+            logger.info("elastic: run completed after %d restart(s)", restarts)
+            return 0
+        if elapsed >= cfg.elastic.reset_after_s:
+            restarts = 0  # the child made real progress; fresh fault budget
+        if restarts >= cfg.elastic.max_restarts:
+            logger.error(
+                "elastic: child rc=%d; restart budget (%d) exhausted — giving up",
+                rc,
+                cfg.elastic.max_restarts,
+            )
+            return rc
+        restarts += 1
+        delay = cfg.elastic.backoff_s * (2 ** (restarts - 1))
+        logger.warning(
+            "elastic: child died rc=%d after %.1fs; restart %d/%d in %.1fs "
+            "(resume from last checkpoint)",
+            rc,
+            elapsed,
+            restarts,
+            cfg.elastic.max_restarts,
+            delay,
+        )
+        time.sleep(delay)
+
+
+# --------------------------------------------------------------------------
+# Fault injection (child side)
+# --------------------------------------------------------------------------
+
+
+def fault_hook_from_env(
+    cfg: ExperimentConfig,
+) -> Optional[Callable[[int, dict], None]]:
+    """``on_step`` hook that hard-kills the process after a designated step.
+
+    ``FRL_FAULT_AT_STEP=N`` → die after completing step N (0-indexed step
+    N-1 in the loop, i.e. when ``step + 1 == N``). A marker file in the
+    workdir makes the fault one-shot so the restarted child survives even
+    when it resumes from a checkpoint before the fault step.
+    """
+    spec = os.environ.get("FRL_FAULT_AT_STEP")
+    if not spec:
+        return None
+    fault_step = int(spec)
+    marker = os.path.join(cfg.workdir, cfg.name, "fault_injected")
+    if os.path.exists(marker):
+        return None
+    logger = get_logger()
+
+    def hook(step: int, metrics: dict) -> None:
+        if step + 1 == fault_step:
+            os.makedirs(os.path.dirname(marker), exist_ok=True)
+            with open(marker, "w") as fh:
+                fh.write(str(fault_step))
+            logger.warning(
+                "fault injection: hard-exit(%d) after step %d",
+                FAULT_EXIT_CODE,
+                fault_step,
+            )
+            sys.stdout.flush()
+            os._exit(FAULT_EXIT_CODE)
+
+    return hook

@@ -66,10 +66,11 @@ def run_experiment(cfg, *, check_imports: bool = True):
     """Train one config to completion; returns (state, last_metrics)."""
     if check_imports:
         _assert_no_cuda_imports()
+    from frl_distributed_ml_scaffold_tpu.launcher.elastic import fault_hook_from_env
     from frl_distributed_ml_scaffold_tpu.trainer.loop import Trainer
 
     trainer = Trainer(cfg)
-    return trainer.fit()
+    return trainer.fit(on_step=fault_hook_from_env(cfg))
 
 
 def _assert_no_cuda_imports() -> None:

@@ -24,9 +24,7 @@ from __future__ import annotations
 from typing import Any
 
 import flax.linen as nn
-import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from frl_distributed_ml_scaffold_tpu.config.schema import GPTConfig
@@ -83,25 +81,18 @@ class CausalSelfAttention(nn.Module):
             )
 
             y = ulysses_attention(q, k, v, axis_name="seq", causal=True)
-        else:  # "dense" | "flash" (flash kernel lands in ops/, falls back)
-            y = _dense_causal_attention(q, k, v)
+        else:
+            from frl_distributed_ml_scaffold_tpu.ops import dense_attention
+
+            # Same op (and the same fp32-softmax numerics) as the trivial-axis
+            # path of ring/ulysses — dense vs. sharded attention differ only
+            # in communication, never in math.
+            y = dense_attention(q, k, v, causal=True)
 
         y = y.reshape(b, t, d)
         y = nn.Dense(d, dtype=self.dtype, name="out")(y)
         y = nn.Dropout(cfg.dropout, deterministic=not train)(y)
         return y
-
-
-def _dense_causal_attention(q, k, v):
-    """Reference attention: fp32 softmax, static causal mask."""
-    b, t, h, hd = q.shape
-    scale = 1.0 / np.sqrt(hd)
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-    logits = logits.astype(jnp.float32)
-    mask = jnp.tril(jnp.ones((t, t), bool))
-    logits = jnp.where(mask[None, None], logits, -1e30)
-    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
 class GptMlp(nn.Module):

@@ -8,12 +8,15 @@ sharding annotations alone:
   attention; PAPERS.md collective-redistribution lineage).
 - ``ulysses_attention`` — DeepSpeed-Ulysses-style ``all_to_all`` reshard
   (seq-sharded ↔ head-sharded) around ordinary dense attention.
-- ``flash_attention`` — fused blockwise attention Pallas kernel for the MXU
-  (ops/pallas/).
+- ``dense_attention`` — the single-device reference all sharded paths
+  reduce to; fp32 softmax, bf16-multiply/fp32-accumulate einsums.
 
 All are drop-in (B, T, H, D)-shaped attention functions used by the GPT
 model's ``attention=`` config switch.
 """
 
-from frl_distributed_ml_scaffold_tpu.ops.ring_attention import ring_attention
+from frl_distributed_ml_scaffold_tpu.ops.ring_attention import (
+    dense_attention,
+    ring_attention,
+)
 from frl_distributed_ml_scaffold_tpu.ops.ulysses import ulysses_attention

@@ -44,7 +44,7 @@ def ring_attention(
     """
     env = current_mesh_env()
     if env is None or env.axis_size(axis_name) == 1:
-        return _single_shard_attention(q, k, v, causal=causal)
+        return dense_attention(q, k, v, causal=causal)
 
     spec = P(BATCH_AXES, axis_name, "model", None)
     inner = partial(_ring_shard_fn, axis_name=axis_name, causal=causal)
@@ -62,7 +62,6 @@ def _ring_shard_fn(q, k, v, *, axis_name: str, causal: bool):
     n = lax.axis_size(axis_name)
     b, t_local, h, d = q.shape
     scale = 1.0 / np.sqrt(d)
-    q32 = q.astype(jnp.float32) * scale
 
     perm = [(i, (i + 1) % n) for i in range(n)]
 
@@ -70,7 +69,14 @@ def _ring_shard_fn(q, k, v, *, axis_name: str, causal: bool):
         k_blk, v_blk, m, l, acc = carry
         # After s rotations this shard holds the block originally at idx - s.
         src = (idx - s) % n
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q32, k_blk.astype(jnp.float32))
+        # bf16 operands, fp32 accumulation: the MXU's native mode (same
+        # contract as dense_attention).
+        logits = (
+            jnp.einsum(
+                "bqhd,bkhd->bhqk", q, k_blk, preferred_element_type=jnp.float32
+            )
+            * scale
+        )
         if causal:
             qpos = idx * t_local + jnp.arange(t_local)[:, None]
             kpos = src * t_local + jnp.arange(t_local)[None, :]
@@ -83,7 +89,10 @@ def _ring_shard_fn(q, k, v, *, axis_name: str, causal: bool):
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + p.sum(axis=-1)
         acc_new = acc * alpha.transpose(0, 2, 1)[..., None] + jnp.einsum(
-            "bhqk,bkhd->bqhd", p, v_blk.astype(jnp.float32)
+            "bhqk,bkhd->bqhd",
+            p.astype(q.dtype),
+            v_blk,
+            preferred_element_type=jnp.float32,
         )
         k_nxt, v_nxt = lax.ppermute((k_blk, v_blk), axis_name, perm)
         return (k_nxt, v_nxt, m_new, l_new, acc_new)
@@ -96,15 +105,30 @@ def _ring_shard_fn(q, k, v, *, axis_name: str, causal: bool):
     return (acc / denom).astype(q.dtype)
 
 
-def _single_shard_attention(q, k, v, *, causal: bool):
-    """Dense fallback with identical numerics contract (fp32 softmax)."""
-    b, t, h, d = q.shape
+def dense_attention(q, k, v, *, causal: bool = True):
+    """(B, T, H, D) dense attention — the numerics contract all sharded
+    paths reduce to when their axis is trivial.
+
+    MXU-friendly mixed precision: einsum operands stay in the input dtype
+    (bf16 under the mixed policy) with fp32 accumulation
+    (``preferred_element_type``) — the MXU's native bf16-multiply /
+    fp32-accumulate mode — and the softmax itself is fp32.
+    """
+    t, d = q.shape[1], q.shape[3]
     scale = 1.0 / np.sqrt(d)
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale,
-                        k.astype(jnp.float32))
+    logits = (
+        jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+        * scale
+    )
     if causal:
         mask = jnp.tril(jnp.ones((t, t), bool))[None, None]
         logits = jnp.where(mask, logits, _NEG_INF)
-    probs = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", probs, v, preferred_element_type=jnp.float32
+    )
     return out.astype(q.dtype)
+
+
+# Backwards-compat private alias (pre-public-export importers).
+_single_shard_attention = dense_attention

@@ -18,9 +18,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from frl_distributed_ml_scaffold_tpu.dist.mesh import BATCH_AXES, current_mesh_env
-from frl_distributed_ml_scaffold_tpu.ops.ring_attention import (
-    _single_shard_attention,
-)
+from frl_distributed_ml_scaffold_tpu.ops.ring_attention import dense_attention
 
 
 def ulysses_attention(
@@ -34,13 +32,16 @@ def ulysses_attention(
     """(B, T, H, D) attention, T sharded over ``axis_name`` (SP-Ulysses)."""
     env = current_mesh_env()
     if env is None or env.axis_size(axis_name) == 1:
-        return _single_shard_attention(q, k, v, causal=causal)
+        return dense_attention(q, k, v, causal=causal)
 
     n = env.axis_size(axis_name)
-    if q.shape[2] % n != 0:
+    tp = env.axis_size("model")
+    # The shard_map spec below shards heads over "model" too, so the
+    # divisibility that matters is of the *local* (per-TP-shard) head count.
+    if q.shape[2] % tp != 0 or (q.shape[2] // tp) % n != 0:
         raise ValueError(
-            f"ulysses needs num_heads ({q.shape[2]}) divisible by "
-            f"seq axis ({n}); use ring attention instead"
+            f"ulysses needs num_heads/model_axis ({q.shape[2]}/{tp}) "
+            f"divisible by seq axis ({n}); use ring attention instead"
         )
 
     spec = P(BATCH_AXES, axis_name, "model", None)
@@ -63,5 +64,5 @@ def _ulysses_shard_fn(q, k, v, *, axis_name: str, causal: bool):
         return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
     qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
-    out = _single_shard_attention(qh, kh, vh, causal=causal)
+    out = dense_attention(qh, kh, vh, causal=causal)
     return to_seq(out)

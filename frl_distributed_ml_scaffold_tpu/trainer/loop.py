@@ -42,8 +42,12 @@ from frl_distributed_ml_scaffold_tpu.utils.trees import tree_param_count
 
 
 def model_partition_rules(model_cfg: Any, env: MeshEnv) -> PartitionRules | None:
-    """TP rules when the model axis is populated (SURVEY C6)."""
-    if env.axis_size("model") <= 1:
+    """TP/EP rules when the model or expert axis is populated (SURVEY C6/C9).
+
+    The rules name both axes; size-1 axes in a spec are no-ops, so applying
+    them with model=1, expert=4 still shards the MoE expert weights.
+    """
+    if env.axis_size("model") <= 1 and env.axis_size("expert") <= 1:
         return None
     family = getattr(model_cfg, "family", None)
     if family == "gpt":

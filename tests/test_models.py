@@ -183,3 +183,15 @@ def test_ulysses_head_divisibility_error():
     q, k, v = _rand_qkv(jax.random.key(3), h=4)  # 4 heads, seq=8 -> error
     with pytest.raises(ValueError, match="divisible"):
         ulysses_attention(q, k, v)
+
+
+def test_ulysses_tp_local_head_divisibility_error():
+    """TP shards heads too: 4 heads / model=2 = 2 local heads, seq=4 -> the
+    *local* count is what must divide (global 4 % 4 == 0 would pass)."""
+    from frl_distributed_ml_scaffold_tpu.ops.ulysses import ulysses_attention
+
+    env = build_mesh(MeshConfig(data=1, model=2, seq=4))
+    set_current_mesh(env)
+    q, k, v = _rand_qkv(jax.random.key(4), h=4)
+    with pytest.raises(ValueError, match="divisible"):
+        ulysses_attention(q, k, v)

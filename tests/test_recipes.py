@@ -231,6 +231,11 @@ def test_moe_recipe_runs(tmp_path):
     )
     trainer = Trainer(cfg)
     state = trainer.init_state()
+    # EP must actually be active (SURVEY C9): expert weights sharded over
+    # the expert axis even with model=1 — a replicated-expert run would
+    # still "learn" and pass the loss check below.
+    wi = state.params["blocks"]["moe"]["wi"]
+    assert "expert" in tuple(wi.sharding.spec), wi.sharding.spec
     losses = []
     for step in range(6):
         batch = trainer.pipeline.global_batch(step)
