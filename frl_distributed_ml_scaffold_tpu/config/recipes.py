@@ -185,3 +185,20 @@ def gpt2_moe() -> ExperimentConfig:
         mesh=MeshConfig(data=-1, expert=4),
         parallel=ParallelConfig(param_sharding="replicated"),
     )
+
+
+@register_config("gpt2_pp")
+def gpt2_pp() -> ExperimentConfig:
+    """Pipeline-parallel LM (SURVEY C7): 4 stages over the ``pipe`` axis,
+    GPipe schedule with 8 microbatches (bubble = 3/11 of a step)."""
+    base = gpt2_medium_zero1()
+    return base.replace(
+        name="gpt2_pp",
+        model=GPTConfig(
+            vocab_size=50257, num_layers=24, num_heads=16, hidden_dim=1024,
+            seq_len=1024, pipeline_stages=4, pipeline_microbatches=8,
+        ),
+        mesh=MeshConfig(data=-1, pipe=4),
+        parallel=ParallelConfig(param_sharding="replicated"),
+        trainer=dataclasses.replace(base.trainer, grad_accum=1),
+    )

@@ -77,7 +77,6 @@ class ParallelConfig:
     opt_sharding: str = "like_params"  # like_params | zero1
     sequence: str = "none"  # none | ring | ulysses
     fsdp_min_size: int = 1024
-    pipeline_microbatches: int = 1
 
 
 @dataclass(frozen=True)
@@ -222,6 +221,11 @@ class GPTConfig:
     # Attention implementation: "dense" | "ring" | "ulysses" | "flash"
     attention: str = "dense"
     moe: MoEConfig = field(default_factory=MoEConfig)
+    # Pipeline parallelism (SURVEY C7): >1 stages the block stack over the
+    # ``pipe`` mesh axis. ``pipeline_microbatches`` = 0 means "same as
+    # stages" (the minimum that keeps every stage busy outside the bubble).
+    pipeline_stages: int = 1
+    pipeline_microbatches: int = 0
 
 
 @dataclass(frozen=True)

@@ -32,23 +32,29 @@ from frl_distributed_ml_scaffold_tpu.parallel.partition import PartitionRules
 from frl_distributed_ml_scaffold_tpu.precision import Policy
 
 
-def gpt_tp_rules() -> PartitionRules:
+def gpt_tp_rules(pipelined: bool = False) -> PartitionRules:
     """Megatron column/row sharding (SURVEY C6). Kernels carry a leading
-    layer dim from nn.scan stacking, hence the extra ``None``."""
-    return PartitionRules(
-        rules=(
-            (r"blocks/attn/(query|key|value)/kernel", P(None, None, "model")),
-            (r"blocks/attn/(query|key|value)/bias", P(None, "model")),
-            (r"blocks/attn/out/kernel", P(None, "model", None)),
-            (r"blocks/mlp/fc_in/kernel", P(None, None, "model")),
-            (r"blocks/mlp/fc_in/bias", P(None, "model")),
-            (r"blocks/mlp/fc_out/kernel", P(None, "model", None)),
-            (r"blocks/moe/wi", P(None, "expert", None, "model")),
-            (r"blocks/moe/wo", P(None, "expert", "model", None)),
-            (r"blocks/moe/router/kernel", P(None, None, None)),
-            (r"wte/embedding", P("model", None)),
-        )
+    layer dim from nn.scan stacking, hence the extra ``None``; under
+    pipeline parallelism they carry ``[stage, layer_in_stage, ...]`` and the
+    stage dim shards over ``pipe`` (SURVEY C7)."""
+    pre: tuple = ("pipe", None) if pipelined else (None,)
+    rules: tuple = (
+        (r"blocks/attn/(query|key|value)/kernel", P(*pre, None, "model")),
+        (r"blocks/attn/(query|key|value)/bias", P(*pre, "model")),
+        (r"blocks/attn/out/kernel", P(*pre, "model", None)),
+        (r"blocks/mlp/fc_in/kernel", P(*pre, None, "model")),
+        (r"blocks/mlp/fc_in/bias", P(*pre, "model")),
+        (r"blocks/mlp/fc_out/kernel", P(*pre, "model", None)),
+        (r"blocks/moe/wi", P(*pre, "expert", None, "model")),
+        (r"blocks/moe/wo", P(*pre, "expert", "model", None)),
+        (r"blocks/moe/router/kernel", P(*pre, None, None)),
+        (r"wte/embedding", P("model", None)),
     )
+    if pipelined:
+        # Everything else inside the stacked blocks (LayerNorm scales etc.)
+        # still lives on its stage. Placed last — first match wins.
+        rules = rules + ((r"blocks/", P("pipe")),)
+    return PartitionRules(rules=rules)
 
 
 class CausalSelfAttention(nn.Module):
@@ -155,13 +161,35 @@ class GPT(nn.Module):
         x = wte(tokens) + wpe[:t].astype(dtype)
         x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
 
-        blocks = nn.scan(
-            Block,
-            length=cfg.num_layers,
-            variable_axes={"params": 0},
-            split_rngs={"params": True, "dropout": True},
-        )(cfg, dtype, train, name="blocks")
-        (x, aux_loss), _ = blocks((x, jnp.zeros((), jnp.float32)), None)
+        if cfg.pipeline_stages > 1:
+            if cfg.attention in ("ring", "ulysses"):
+                # Those ops open their own shard_map regions, which cannot
+                # nest inside the pipeline's vmapped stage body.
+                raise ValueError(
+                    f"attention={cfg.attention!r} does not compose with "
+                    "pipeline_stages > 1; use dense/flash attention"
+                )
+            from frl_distributed_ml_scaffold_tpu.parallel.pipeline import (
+                SpmdPipeline,
+            )
+
+            pipe = SpmdPipeline(
+                Block,
+                (cfg, dtype, train),
+                num_layers=cfg.num_layers,
+                num_stages=cfg.pipeline_stages,
+                num_microbatches=cfg.pipeline_microbatches or cfg.pipeline_stages,
+                name="pipeline",
+            )
+            x, aux_loss = pipe(x, jnp.zeros((), jnp.float32))
+        else:
+            blocks = nn.scan(
+                Block,
+                length=cfg.num_layers,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+            )(cfg, dtype, train, name="blocks")
+            (x, aux_loss), _ = blocks((x, jnp.zeros((), jnp.float32)), None)
 
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         logits = wte.attend(x.astype(dtype))  # weight-tied LM head

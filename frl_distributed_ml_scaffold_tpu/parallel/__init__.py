@@ -23,3 +23,4 @@ from frl_distributed_ml_scaffold_tpu.parallel.partition import (
     param_specs,
     shardings_from_specs,
 )
+from frl_distributed_ml_scaffold_tpu.parallel.pipeline import SpmdPipeline

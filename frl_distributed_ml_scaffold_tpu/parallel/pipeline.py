@@ -1,0 +1,160 @@
+"""Pipeline parallelism: GPipe schedule expressed in GSPMD (SURVEY C7).
+
+The reference stages a model across device groups with MPMD ranks and
+point-to-point NCCL sends. TPU-native, the whole pipeline stays inside the
+one compiled SPMD program (cf. the MPMD-PP and pjit papers in PAPERS.md):
+
+- **Stage-stacked parameters.** The repeated block is wrapped in
+  ``nn.scan`` (layers within a stage) inside ``nn.vmap`` (across stages),
+  so every block parameter carries leading dims ``[S, L/S, ...]`` and the
+  stage dim is sharded over the ``pipe`` mesh axis — each stage's weights
+  live only on its pipeline group.
+- **Rolling activation buffer.** A ``[S, microbatch, ...]`` buffer, also
+  sharded over ``pipe`` on dim 0, holds the activation each stage is
+  currently working on. One schedule tick = every stage applies its layers
+  to its slot (the vmapped compute partitions across ``pipe``), then the
+  buffer rolls by one: ``jnp.roll`` on a pipe-sharded dim compiles to the
+  collective-permute that is the stage-to-stage send.
+- **GPipe timeline.** ``lax.scan`` over ``M + S - 1`` ticks: stage 0
+  ingests microbatch ``t`` at tick ``t``, the last stage emits microbatch
+  ``t - (S-1)``; the (S-1)-tick fill/drain bubble is the standard GPipe
+  cost, amortized by ``num_microbatches``. The backward pass needs no
+  hand-written schedule at all — autodiff through roll/scan yields the
+  reverse pipeline, and XLA's latency-hiding scheduler overlaps the
+  permutes with compute.
+
+Because nothing here leaves GSPMD-land, PP composes freely with DP/FSDP
+(batch axes on the microbatch dim) and TP (``model`` axis inside each
+stage's weights). Ring/Ulysses attention embed their own ``shard_map``
+regions and cannot nest inside the vmapped stage body — the model layer
+rejects that combination up front.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from frl_distributed_ml_scaffold_tpu.dist.mesh import BATCH_AXES, current_mesh_env
+
+
+def _constrain(x: jax.Array, *leading_axes) -> jax.Array:
+    """Sharding-constrain the leading dims of ``x`` (no-op without a mesh)."""
+    env = current_mesh_env()
+    if env is None:
+        return x
+    spec = P(*leading_axes, *([None] * (x.ndim - len(leading_axes))))
+    return jax.lax.with_sharding_constraint(x, NamedSharding(env.mesh, spec))
+
+
+class _PipelineTick(nn.Module):
+    """One schedule tick: ingest → vmapped stage compute → roll.
+
+    Scanned over the timeline with ``variable_broadcast="params"`` so the
+    stage weights are created once and reused every tick.
+    """
+
+    block_cls: Any  # scan-signature module: __call__((x, aux), _) -> ((x, aux), None)
+    block_args: tuple
+    num_stages: int
+    layers_per_stage: int
+
+    @nn.compact
+    def __call__(self, carry, xs):
+        buf, aux_acc = carry  # buf: [S, mb, ...]; aux_acc: scalar
+        inp, valid = xs  # inp: [mb, ...] feed for stage 0; valid: [S] this tick
+        s = self.num_stages
+
+        # Layers within a stage run sequentially (nn.scan); stages run as one
+        # batched computation over the stage dim (nn.vmap) that GSPMD
+        # partitions across ``pipe`` — params get leading dims [S, L/S, ...].
+        stage = nn.scan(
+            self.block_cls,
+            length=self.layers_per_stage,
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "dropout": True},
+        )
+        body = nn.vmap(
+            stage,
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "dropout": True},
+            in_axes=((0, 0), None),
+            out_axes=((0, 0), None),
+            axis_size=s,
+        )(*self.block_args, name="blocks")
+
+        buf = buf.at[0].set(inp.astype(buf.dtype))
+        buf = _constrain(buf, "pipe", BATCH_AXES)
+        (out, aux_delta), _ = body((buf, jnp.zeros((s,), jnp.float32)), None)
+        # Bubble ticks process garbage slots; mask their aux contribution.
+        aux_acc = aux_acc + jnp.sum(aux_delta * valid.astype(jnp.float32))
+        y = out[s - 1]  # last stage's emission (valid from tick S-1 on)
+        buf_next = _constrain(jnp.roll(out, 1, axis=0), "pipe", BATCH_AXES)
+        return (buf_next, aux_acc), y
+
+
+class SpmdPipeline(nn.Module):
+    """Pipeline a stack of ``num_layers`` blocks over ``num_stages`` stages.
+
+    ``block_cls(*block_args)`` must have the scan signature
+    ``((x, aux_scalar), None) -> ((x, aux_scalar), None)``. The input batch
+    dim must divide into ``num_microbatches``.
+    """
+
+    block_cls: Any
+    block_args: tuple
+    num_layers: int
+    num_stages: int
+    num_microbatches: int
+
+    @nn.compact
+    def __call__(self, x: jax.Array, aux0: jax.Array):
+        s, m = self.num_stages, self.num_microbatches
+        if self.num_layers % s:
+            raise ValueError(f"{self.num_layers} layers not divisible by {s} stages")
+        if x.shape[0] % m:
+            raise ValueError(f"batch {x.shape[0]} not divisible by {m} microbatches")
+        mb = x.shape[0] // m
+        ticks = m + s - 1
+
+        x_mb = _constrain(
+            x.reshape((m, mb) + x.shape[1:]), None, BATCH_AXES
+        )
+        # Stage-0 feed per tick: microbatch t while t < M, dead inputs after.
+        if s > 1:
+            pad = jnp.zeros((s - 1,) + x_mb.shape[1:], x_mb.dtype)
+            feed = jnp.concatenate([x_mb, pad])
+        else:
+            feed = x_mb
+        # valid[t, j] — stage j holds real data (microbatch t-j) at tick t.
+        t_idx = jnp.arange(ticks)[:, None]
+        s_idx = jnp.arange(s)[None, :]
+        valid = (t_idx - s_idx >= 0) & (t_idx - s_idx < m)
+
+        timeline = nn.scan(
+            _PipelineTick,
+            length=ticks,
+            variable_broadcast="params",
+            split_rngs={"params": False, "dropout": True},
+        )(
+            self.block_cls,
+            self.block_args,
+            s,
+            self.num_layers // s,
+            name="ticks",
+        )
+        buf0 = _constrain(
+            jnp.zeros((s, mb) + x.shape[1:], x.dtype), "pipe", BATCH_AXES
+        )
+        (_, aux_sum), ys = timeline((buf0, jnp.zeros((), jnp.float32)), (feed, valid))
+        # Per-layer aux terms (e.g. the MoE router loss) are means over their
+        # microbatch, so the schedule accumulates M full copies of the
+        # plain-path value — average them back to batch-size-invariant form.
+        aux = aux0 + aux_sum / m
+        # Microbatch t emerges from the last stage at tick t + S - 1.
+        out = ys[s - 1 :].reshape((m * mb,) + ys.shape[2:])
+        return _constrain(out, BATCH_AXES), aux

@@ -42,18 +42,24 @@ from frl_distributed_ml_scaffold_tpu.utils.trees import tree_param_count
 
 
 def model_partition_rules(model_cfg: Any, env: MeshEnv) -> PartitionRules | None:
-    """TP/EP rules when the model or expert axis is populated (SURVEY C6/C9).
+    """TP/EP/PP rules when the model/expert/pipe axis is populated
+    (SURVEY C6/C7/C9).
 
-    The rules name both axes; size-1 axes in a spec are no-ops, so applying
+    The rules name all axes; size-1 axes in a spec are no-ops, so applying
     them with model=1, expert=4 still shards the MoE expert weights.
     """
-    if env.axis_size("model") <= 1 and env.axis_size("expert") <= 1:
+    pipelined = getattr(model_cfg, "pipeline_stages", 1) > 1
+    if (
+        env.axis_size("model") <= 1
+        and env.axis_size("expert") <= 1
+        and not pipelined
+    ):
         return None
     family = getattr(model_cfg, "family", None)
     if family == "gpt":
         from frl_distributed_ml_scaffold_tpu.models.gpt import gpt_tp_rules
 
-        return gpt_tp_rules()
+        return gpt_tp_rules(pipelined=pipelined)
     return None
 
 
