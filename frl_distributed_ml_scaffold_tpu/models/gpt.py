@@ -87,6 +87,12 @@ class CausalSelfAttention(nn.Module):
             )
 
             y = ulysses_attention(q, k, v, axis_name="seq", causal=True)
+        elif cfg.attention == "flash":
+            from frl_distributed_ml_scaffold_tpu.ops.flash_attention import (
+                flash_attention,
+            )
+
+            y = flash_attention(q, k, v, causal=True)
         else:
             from frl_distributed_ml_scaffold_tpu.ops import dense_attention
 

@@ -8,6 +8,8 @@ sharding annotations alone:
   attention; PAPERS.md collective-redistribution lineage).
 - ``ulysses_attention`` — DeepSpeed-Ulysses-style ``all_to_all`` reshard
   (seq-sharded ↔ head-sharded) around ordinary dense attention.
+- ``flash_attention`` — the fused Pallas TPU kernel (online-softmax fwd +
+  two-kernel custom-VJP bwd); the framework's hand-written "native" tier.
 - ``dense_attention`` — the single-device reference all sharded paths
   reduce to; fp32 softmax, bf16-multiply/fp32-accumulate einsums.
 
@@ -15,6 +17,7 @@ All are drop-in (B, T, H, D)-shaped attention functions used by the GPT
 model's ``attention=`` config switch.
 """
 
+from frl_distributed_ml_scaffold_tpu.ops.flash_attention import flash_attention
 from frl_distributed_ml_scaffold_tpu.ops.ring_attention import (
     dense_attention,
     ring_attention,

@@ -1,0 +1,364 @@
+"""Pallas TPU flash attention: fused blockwise causal attention kernel.
+
+SURVEY §7 names the custom-kernel tier as the framework's "native" layer on
+TPU (the CUDA-kernel equivalent). This is that tier's centerpiece: a
+flash-attention forward + backward written directly against the Mosaic/TPU
+pipeline via ``pl.pallas_call``:
+
+- **Forward**: online-softmax with K/V streamed block-by-block through an
+  inner grid dimension — VMEM residency is O(block·D), independent of T, so
+  context length is bounded by HBM, not VMEM. The per-row logsumexp (a
+  lane-1 (B, H, T, 1) array — the only extra HBM traffic) is saved for the
+  backward. Running max/denominator/accumulator live in VMEM scratch that
+  persists across the inner grid steps (TPU grids iterate sequentially).
+- **Backward**: custom VJP with two kernels — one producing dQ (inner grid
+  over K/V blocks), one producing dK/dV (inner grid over Q/dO blocks) — the
+  flash-attention-2 split so each output block has a single writer. The row
+  term ``delta = rowsum(dO·O)`` is computed in-VMEM from tiles already
+  resident instead of being broadcast through HBM.
+- **Causality**: blocks strictly above the diagonal skip their compute via
+  ``pl.when`` (the MXU work — the dominant cost — is elided; only the
+  block DMA is not).
+
+Layout: kernels run in (B, H, T, D) — Mosaic requires the (sublane, lane)
+pair to be the (T-block, D) tile — with the public API staying (B, T, H, D);
+the wrapper's transposes fuse into the surrounding projection matmuls. All
+matmuls run bf16-multiply/fp32-accumulate (``preferred_element_type``),
+softmax math in fp32 — the same numerics contract as ``dense_attention``,
+which the tests assert equivalence against.
+
+On non-TPU backends the kernels run in Pallas interpreter mode so the CPU
+test suite exercises the exact same code path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1.0e30
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+_warned: set[str] = set()
+
+
+def _warn_fallback(msg: str) -> None:
+    """Log each distinct fallback reason once — silent perf cliffs are the
+    review-flagged failure mode; a log line per step would be the other."""
+    if msg not in _warned:
+        _warned.add(msg)
+        from frl_distributed_ml_scaffold_tpu.utils.logging import get_logger
+
+        get_logger().warning(msg)
+
+
+def _pick_block(t: int, preferred: int) -> int | None:
+    """Largest power-of-two block <= preferred that divides t.
+
+    Only power-of-two candidates: anything else risks a sublane-misaligned
+    tile that Mosaic rejects at compile time — untileable T falls back to
+    dense attention instead.
+    """
+    for b in (512, 256, 128, 64, 32, 16, 8):
+        if b <= preferred and t % b == 0:
+            return b
+    return None
+
+
+def _causal_mask(s, i, j, block_q, block_k):
+    qpos = i * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    kpos = j * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(qpos >= kpos, s, _NEG_INF)
+
+
+def _dot(a, b, *, trans_b=False, trans_a=False):
+    """MXU matmul, fp32 accumulate."""
+    dims = (((0,) if trans_a else (1,), (1,) if trans_b else (0,)), ((), ()))
+    return lax.dot_general(a, b, dimension_numbers=dims,
+                           preferred_element_type=jnp.float32)
+
+
+# --------------------------------------------------------------------- fwd
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
+                *, block_q, block_k, causal, scale):
+    i, j = pl.program_id(2), pl.program_id(3)
+    n_k = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # Blocks strictly above the causal diagonal contribute nothing.
+    live = (j * block_k <= (i + 1) * block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0, 0, :, :]  # (Bq, D)
+        k_blk = k_ref[0, 0, :, :]  # (Bk, D)
+        v_blk = v_ref[0, 0, :, :]
+        s = _dot(q, k_blk, trans_b=True) * scale  # (Bq, Bk)
+        if causal:
+            s = _causal_mask(s, i, j, block_q, block_k)
+        m = m_ref[:]
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        m_ref[:] = m_new
+        l_ref[:] = l_ref[:] * alpha + p.sum(axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + _dot(p.astype(v_blk.dtype), v_blk)
+
+    @pl.when(j == n_k - 1)
+    def _finish():
+        l_safe = jnp.maximum(l_ref[:], 1e-30)
+        o_ref[0, 0, :, :] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0, 0, :, :] = m_ref[:] + jnp.log(l_safe)
+
+
+def _clamp_j(causal, block_q, block_k):
+    """KV index map for causal grids: clamp j to the diagonal block so
+    programs above the diagonal reference the block already resident —
+    their compute is skipped by ``pl.when`` and no DMA fires."""
+    if not causal:
+        return lambda b_, h_, i, j: (b_, h_, j, 0)
+    return lambda b_, h_, i, j: (
+        b_, h_, jnp.minimum(j, ((i + 1) * block_q - 1) // block_k), 0
+    )
+
+
+def _clamp_i(causal, block_q, block_k):
+    """Q-side index map for the dkv grid (outer j over K blocks): clamp i
+    up to the first Q block that reaches the diagonal."""
+    if not causal:
+        return lambda b_, h_, j, i: (b_, h_, i, 0)
+    return lambda b_, h_, j, i: (
+        b_, h_, jnp.maximum(i, (j * block_k) // block_q), 0
+    )
+
+
+def _fwd(q, k, v, *, causal, block_q, block_k, interpret):
+    """q, k, v in kernel layout (B, H, T, D)."""
+    b, h, t, d = q.shape
+    scale = 1.0 / np.sqrt(d)
+    q_spec = pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0))
+    kv_spec = pl.BlockSpec((1, 1, block_k, d), _clamp_j(causal, block_q, block_k))
+    lse_spec = pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, i, j: (b_, h_, i, 0))
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, block_q=block_q, block_k=block_k,
+                          causal=causal, scale=scale),
+        grid=(b, h, t // block_q, t // block_k),
+        in_specs=[q_spec, kv_spec, kv_spec],
+        out_specs=[q_spec, lse_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((b, h, t, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),   # running max
+            pltpu.VMEM((block_q, 1), jnp.float32),   # running denom
+            pltpu.VMEM((block_q, d), jnp.float32),   # output accumulator
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+# --------------------------------------------------------------------- bwd
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
+                   dq_acc_ref, delta_ref, *, block_q, block_k, causal, scale):
+    i, j = pl.program_id(2), pl.program_id(3)
+    n_k = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc_ref[:] = jnp.zeros_like(dq_acc_ref)
+        do = do_ref[0, 0, :, :].astype(jnp.float32)
+        o = o_ref[0, 0, :, :].astype(jnp.float32)
+        delta_ref[:] = (do * o).sum(axis=-1, keepdims=True)  # (Bq, 1)
+
+    live = (j * block_k <= (i + 1) * block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0, 0, :, :]
+        k_blk = k_ref[0, 0, :, :]
+        v_blk = v_ref[0, 0, :, :]
+        do = do_ref[0, 0, :, :].astype(jnp.float32)
+        lse = lse_ref[0, 0, :, :]  # (Bq, 1)
+        s = _dot(q, k_blk, trans_b=True) * scale
+        if causal:
+            s = _causal_mask(s, i, j, block_q, block_k)
+        p = jnp.exp(s - lse)  # exact probabilities — no rescaling needed
+        dp = _dot(do, v_blk.astype(jnp.float32), trans_b=True)
+        ds = p * (dp - delta_ref[:]) * scale
+        dq_acc_ref[:] += _dot(ds.astype(k_blk.dtype), k_blk)
+
+    @pl.when(j == n_k - 1)
+    def _finish():
+        dq_ref[0, 0, :, :] = dq_acc_ref[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+                    dk_ref, dv_ref, dk_acc_ref, dv_acc_ref,
+                    *, block_q, block_k, causal, scale):
+    j, i = pl.program_id(2), pl.program_id(3)
+    n_q = pl.num_programs(3)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
+
+    live = ((i + 1) * block_q - 1 >= j * block_k) if causal else True
+
+    @pl.when(live)
+    def _step():
+        k_blk = k_ref[0, 0, :, :]  # (Bk, D)
+        v_blk = v_ref[0, 0, :, :]
+        q = q_ref[0, 0, :, :]
+        do = do_ref[0, 0, :, :].astype(jnp.float32)
+        o = o_ref[0, 0, :, :].astype(jnp.float32)
+        lse = lse_ref[0, 0, :, :]
+        delta = (do * o).sum(axis=-1, keepdims=True)  # (Bq, 1)
+        s = _dot(q, k_blk, trans_b=True) * scale
+        if causal:
+            s = _causal_mask(s, i, j, block_q, block_k)
+        p = jnp.exp(s - lse)  # (Bq, Bk)
+        dv_acc_ref[:] += _dot(p, do, trans_a=True)
+        dp = _dot(do, v_blk.astype(jnp.float32), trans_b=True)
+        ds = p * (dp - delta) * scale  # (Bq, Bk)
+        dk_acc_ref[:] += _dot(ds, q.astype(jnp.float32), trans_a=True)
+
+    @pl.when(i == n_q - 1)
+    def _finish():
+        dk_ref[0, 0, :, :] = dk_acc_ref[:].astype(dk_ref.dtype)
+        dv_ref[0, 0, :, :] = dv_acc_ref[:].astype(dv_ref.dtype)
+
+
+def _bwd(causal, block_q, block_k, interpret, residuals, dout):
+    q, k, v, o, lse = residuals
+    b, h, t, d = q.shape
+    scale = 1.0 / np.sqrt(d)
+    n_q, n_k = t // block_q, t // block_k
+
+    # dq: outer grid over Q blocks, inner over K/V blocks.
+    qi_spec = pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0))
+    kj_spec = pl.BlockSpec((1, 1, block_k, d), _clamp_j(causal, block_q, block_k))
+    lse_i = pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, i, j: (b_, h_, i, 0))
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, block_q=block_q, block_k=block_k,
+                          causal=causal, scale=scale),
+        grid=(b, h, n_q, n_k),
+        in_specs=[qi_spec, kj_spec, kj_spec, qi_spec, qi_spec, lse_i],
+        out_specs=qi_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),  # dq accumulator
+            pltpu.VMEM((block_q, 1), jnp.float32),  # delta row term
+        ],
+        interpret=interpret,
+    )(q, k, v, o, dout, lse)
+
+    # dk/dv: outer grid over K blocks, inner over Q/dO blocks.
+    qi2 = pl.BlockSpec((1, 1, block_q, d), _clamp_i(causal, block_q, block_k))
+    kj2 = pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, j, i: (b_, h_, j, 0))
+    _ci = _clamp_i(causal, block_q, block_k)
+    lse_i2 = pl.BlockSpec((1, 1, block_q, 1), _ci)
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, block_q=block_q, block_k=block_k,
+                          causal=causal, scale=scale),
+        grid=(b, h, n_k, n_q),
+        in_specs=[qi2, kj2, kj2, qi2, qi2, lse_i2],
+        out_specs=[kj2, kj2],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),  # dk accumulator
+            pltpu.VMEM((block_k, d), jnp.float32),  # dv accumulator
+        ],
+        interpret=interpret,
+    )(q, k, v, o, dout, lse)
+    return dq, dk, dv
+
+
+# ------------------------------------------------------------------ public
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    o, _ = _fwd(q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+                interpret=interpret)
+    return o
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    o, lse = _fwd(q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+                  interpret=interpret)
+    return o, (q, k, v, o, lse)
+
+
+_flash.defvjp(_flash_fwd, _bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    block_q: int = 256,
+    block_k: int = 256,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """(B, T, H, D) fused flash attention; drop-in for ``dense_attention``.
+
+    Falls back to ``dense_attention`` when T doesn't tile (no power-of-two
+    block divides it) or the head dim isn't sublane-aligned — the numerics
+    contract is identical, so the fallback is silent by design.
+    """
+    from frl_distributed_ml_scaffold_tpu.ops.ring_attention import dense_attention
+
+    t, d = q.shape[1], q.shape[3]
+    bq = _pick_block(t, min(block_q, t))
+    bk = _pick_block(t, min(block_k, t))
+    if bq is None or bk is None or d % 32 != 0:
+        _warn_fallback(
+            f"flash_attention falling back to dense: shape (T={t}, head_dim="
+            f"{d}) is not tileable (need a power-of-two divisor of T and "
+            f"head_dim % 32 == 0)"
+        )
+        return dense_attention(q, k, v, causal=causal)
+    if interpret is None:
+        if _interpret_default():
+            # Pallas interpreter mode is orders of magnitude slower than the
+            # identical-numerics dense path — only tests (which pass
+            # interpret=True explicitly) should ever run it.
+            _warn_fallback(
+                "flash_attention falling back to dense on non-TPU backend "
+                f"({jax.default_backend()}); pass interpret=True to force "
+                "the Pallas interpreter"
+            )
+            return dense_attention(q, k, v, causal=causal)
+        interpret = False
+    # Kernel layout is (B, H, T, D); these transposes sit against the QKV
+    # projection reshapes and fuse in XLA.
+    qT, kT, vT = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+    o = _flash(qT, kT, vT, causal, bq, bk, interpret)
+    return o.transpose(0, 2, 1, 3)
