@@ -33,8 +33,9 @@ def bench_config(name: str, overrides: list[str], *, steps: int, warmup: int):
     cfg = apply_overrides(get_config(name), overrides)
     trainer = Trainer(cfg)
     state = trainer.init_state()
-    # One device-resident batch, reused: the benchmark measures the chip
-    # (fwd+bwd+update), not the host loader (BASELINE.md protocol).
+    # One device-resident batch, reused (global_batch returns sharded
+    # jax.Arrays): the benchmark measures the chip (fwd+bwd+update), not the
+    # host loader (BASELINE.md protocol).
     batch = trainer.pipeline.global_batch(0)
     # Windowed timing: sync on the loss once per window, steps inside a
     # window pipeline as in a real training loop (per-step syncs would
@@ -58,7 +59,9 @@ def main() -> int:
         (
             "rn50_imagenet_samples_per_sec_per_chip",
             "imagenet_rn50_ddp",
-            ["data.global_batch_size=256", "trainer.log_every=1000000"],
+            # bs=512 is the measured single-chip throughput knee (256: 1905,
+            # 512: 2025, 1024: 1842 samples/sec/chip on v5e).
+            ["data.global_batch_size=512", "trainer.log_every=1000000"],
             20,
         ),
         (

@@ -119,6 +119,10 @@ class TrainerConfig:
     eval_every: int = 0  # 0 = no eval during training
     eval_steps: int = 10
     seed: int = 0
+    # Profiling (SURVEY C19): capture a jax.profiler trace for
+    # [profile_start_step, profile_start_step + profile_steps). 0 = off.
+    profile_steps: int = 0
+    profile_start_step: int = 10
 
 
 @dataclass(frozen=True)

@@ -39,6 +39,12 @@ def _parse_args(argv=None):
     p.add_argument(
         "--coordinator", default=None, help="host:port for multi-host bring-up"
     )
+    p.add_argument(
+        "--hlo-dump",
+        default=None,
+        metavar="DIR",
+        help="dump optimized HLO per compilation to DIR (SURVEY C19)",
+    )
     p.add_argument("--num-processes", type=int, default=None)
     p.add_argument("--process-id", type=int, default=None)
     p.add_argument(
@@ -49,6 +55,12 @@ def _parse_args(argv=None):
 
 def _configure_platform(args) -> None:
     """Must run before jax initializes a backend."""
+    if args.hlo_dump:
+        from frl_distributed_ml_scaffold_tpu.utils.profiling import hlo_dump_flags
+
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") + " " + hlo_dump_flags(args.hlo_dump)
+        ).strip()
     if args.device == "cpu":
         os.environ["JAX_PLATFORMS"] = "cpu"
         if args.sim_devices > 1:
@@ -109,6 +121,9 @@ def main(argv=None) -> int:
     from frl_distributed_ml_scaffold_tpu.utils.logging import get_logger
 
     initialize_distributed(args.coordinator, args.num_processes, args.process_id)
+    from frl_distributed_ml_scaffold_tpu.utils.debugging import sanitize_from_env
+
+    sanitize_from_env()  # FRL_TPU_SANITIZE=nans,infs,leaks (SURVEY §5)
     logger = get_logger()
     logger.info("launching %s\n%s", cfg.name, pretty_config(cfg))
     _, last = run_experiment(cfg)

@@ -168,12 +168,13 @@ class GPT(nn.Module):
         x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
 
         if cfg.pipeline_stages > 1:
-            if cfg.attention in ("ring", "ulysses"):
-                # Those ops open their own shard_map regions, which cannot
-                # nest inside the pipeline's vmapped stage body.
+            if cfg.attention in ("ring", "ulysses", "flash"):
+                # Those ops open their own shard_map regions (flash: to keep
+                # the Pallas call per-device under GSPMD), which cannot nest
+                # inside the pipeline's vmapped stage body.
                 raise ValueError(
                     f"attention={cfg.attention!r} does not compose with "
-                    "pipeline_stages > 1; use dense/flash attention"
+                    "pipeline_stages > 1; use dense attention"
                 )
             from frl_distributed_ml_scaffold_tpu.parallel.pipeline import (
                 SpmdPipeline,

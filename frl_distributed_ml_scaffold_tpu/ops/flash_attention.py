@@ -333,6 +333,10 @@ def flash_attention(
     block divides it) or the head dim isn't sublane-aligned — the numerics
     contract is identical, so the fallback is silent by design.
     """
+    from frl_distributed_ml_scaffold_tpu.dist.mesh import (
+        BATCH_AXES,
+        current_mesh_env,
+    )
     from frl_distributed_ml_scaffold_tpu.ops.ring_attention import dense_attention
 
     t, d = q.shape[1], q.shape[3]
@@ -357,8 +361,33 @@ def flash_attention(
             )
             return dense_attention(q, k, v, causal=causal)
         interpret = False
-    # Kernel layout is (B, H, T, D); these transposes sit against the QKV
-    # projection reshapes and fuse in XLA.
-    qT, kT, vT = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
-    o = _flash(qT, kT, vT, causal, bq, bk, interpret)
-    return o.transpose(0, 2, 1, 3)
+
+    def _call(q, k, v):
+        # Kernel layout is (B, H, T, D); these transposes sit against the
+        # QKV projection reshapes and fuse in XLA.
+        qT, kT, vT = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+        o = _flash(qT, kT, vT, causal, bq, bk, interpret)
+        return o.transpose(0, 2, 1, 3)
+
+    env = current_mesh_env()
+    if env is None:
+        return _call(q, k, v)
+    # Under a mesh, GSPMD cannot partition an opaque pallas_call — an
+    # unwrapped kernel would silently all-gather and run replicated. Flash
+    # attention is independent per (batch, head), so shard_map over the
+    # batch axes and the TP head axis keeps it fully local (same mechanism
+    # as the ring/Ulysses siblings). Sequence sharding is ring attention's
+    # job, not this kernel's.
+    if env.axis_size("seq") > 1:
+        raise ValueError(
+            "attention='flash' does not shard the sequence axis; use "
+            "attention='ring' (or 'ulysses') when mesh.seq > 1"
+        )
+    spec = jax.sharding.PartitionSpec(BATCH_AXES, None, "model", None)
+    return jax.shard_map(
+        _call,
+        mesh=env.mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )(q, k, v)

@@ -214,33 +214,53 @@ class Trainer:
         last_record: dict = {}
         last_logged = start_step
 
-        for step in range(start_step, total):
-            batch = self.pipeline.global_batch(step)
-            state, metrics = self.train_step(state, batch)
-            if (step + 1) % cfg.trainer.log_every == 0 or step + 1 == total:
-                timer.tick_window(metrics["loss"], step + 1 - last_logged)
-                last_logged = step + 1
-                perf = timer.summary(samples_per_step)
-                extra = {
-                    "lr": float(self.schedule(step)),
-                    **{
-                        k: round(v, 6)
-                        for k, v in perf.items()
-                        if k in ("step_time_median_s", "samples_per_sec_per_chip")
-                    },
-                }
-                last_record = metric_logger.log(step + 1, metrics, extra)
-            if on_step is not None:
-                on_step(step, metrics)
-            if (
-                self.checkpointer is not None
-                and (step + 1) % cfg.checkpoint.save_every == 0
-            ):
-                self.checkpointer.save(step + 1, state)
-            if cfg.trainer.eval_every and (step + 1) % cfg.trainer.eval_every == 0:
-                eval_metrics = self.evaluate(state)
-                metric_logger.log(step + 1, eval_metrics, {"split": "eval"})
+        from frl_distributed_ml_scaffold_tpu.utils.profiling import (
+            WindowProfiler,
+            annotate,
+            annotate_step,
+        )
 
+        profiler = WindowProfiler(
+            os.path.join(cfg.workdir, cfg.name, "trace"),
+            start_step=start_step + cfg.trainer.profile_start_step,
+            num_steps=cfg.trainer.profile_steps,
+        )
+
+        try:
+            for step in range(start_step, total):
+                profiler.step_start(step)
+                with annotate("load_batch"):
+                    batch = self.pipeline.global_batch(step)
+                with annotate_step(step):
+                    state, metrics = self.train_step(state, batch)
+                if (step + 1) % cfg.trainer.log_every == 0 or step + 1 == total:
+                    timer.tick_window(metrics["loss"], step + 1 - last_logged)
+                    last_logged = step + 1
+                    perf = timer.summary(samples_per_step)
+                    extra = {
+                        "lr": float(self.schedule(step)),
+                        **{
+                            k: round(v, 6)
+                            for k, v in perf.items()
+                            if k in ("step_time_median_s", "samples_per_sec_per_chip")
+                        },
+                    }
+                    last_record = metric_logger.log(step + 1, metrics, extra)
+                if on_step is not None:
+                    on_step(step, metrics)
+                if (
+                    self.checkpointer is not None
+                    and (step + 1) % cfg.checkpoint.save_every == 0
+                ):
+                    self.checkpointer.save(step + 1, state)
+                if cfg.trainer.eval_every and (step + 1) % cfg.trainer.eval_every == 0:
+                    eval_metrics = self.evaluate(state)
+                    metric_logger.log(step + 1, eval_metrics, {"split": "eval"})
+        finally:
+            # A crash mid-window must still flush the captured trace (and
+            # release the process-wide profiler) — the crash run is exactly
+            # when the trace is wanted.
+            profiler.stop()
         if self.checkpointer is not None:
             if total % cfg.checkpoint.save_every != 0:
                 # Final state not yet covered by the periodic save above.

@@ -1,0 +1,107 @@
+"""Sanitizers / race detection (SURVEY §5 "Race detection/sanitizers").
+
+The reference's sanitizer tier (TSAN / compute-sanitizer over its native
+deps) has no direct TPU equivalent because the failure class it hunts —
+data races on shared mutable device memory — is removed by construction
+here: JAX programs are pure functions over immutable arrays, and all
+mutation (donation, double-buffering) is mediated by XLA with aliasing
+checked at compile time. What remains detectable at runtime, this module
+turns on:
+
+- **NaN/Inf detection** (``jax_debug_nans`` / ``jax_debug_infs``): every
+  primitive re-checked, failing with the offending op's traceback — the
+  numerics analog of a sanitizer trap. Large overhead; debug runs only.
+- **Tracer leak detection** (``jax_check_tracer_leaks``): catches escaped
+  tracers from side-effecting closures — the JAX-specific "race" of
+  captured stale state.
+- **Donation/aliasing hygiene**: using a donated buffer raises by default;
+  ``strict_donation()`` upgrades the *warning* on non-donatable layouts to
+  an error so silent copies don't mask aliasing assumptions.
+- **Deterministic replay**: disabling XLA autotuning-dependent fusion
+  reordering isn't needed on TPU (deterministic by default — document this
+  as the determinism story vs. CUDA's atomics nondeterminism).
+
+Usage: ``with sanitize():`` around a suspect run, or ``sanitize_from_env()``
+at process start honoring ``FRL_TPU_SANITIZE=nans,leaks``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import warnings
+from typing import Iterator
+
+import jax
+
+from frl_distributed_ml_scaffold_tpu.utils.logging import get_logger
+
+_FLAG_MAP = {
+    "nans": "jax_debug_nans",
+    "infs": "jax_debug_infs",
+    "leaks": "jax_check_tracer_leaks",
+}
+
+
+@contextlib.contextmanager
+def sanitize(*checks: str) -> Iterator[None]:
+    """Enable runtime sanitizers for the scope. Default: all of them.
+
+    ``checks`` ⊆ {"nans", "infs", "leaks"}.
+    """
+    names = checks or tuple(_FLAG_MAP)
+    saved = {}
+    for name in names:
+        flag = _FLAG_MAP[name]  # KeyError = typo'd sanitizer name, surface it
+        saved[flag] = getattr(jax.config, flag)
+        jax.config.update(flag, True)
+    try:
+        yield
+    finally:
+        for flag, old in saved.items():
+            jax.config.update(flag, old)
+
+
+def sanitize_from_env(var: str = "FRL_TPU_SANITIZE") -> bool:
+    """Process-wide sanitizer enable from the environment (no scope exit).
+
+    ``FRL_TPU_SANITIZE=1`` or ``=all`` turns everything on;
+    ``FRL_TPU_SANITIZE=nans,leaks`` selects. Returns True if anything was
+    enabled.
+    """
+    val = os.environ.get(var, "").strip().lower()
+    if not val or val in ("0", "false"):
+        return False
+    names = tuple(_FLAG_MAP) if val in ("1", "true", "all") else tuple(
+        n.strip() for n in val.split(",") if n.strip()
+    )
+    enabled = []
+    for name in names:
+        flag = _FLAG_MAP.get(name)
+        if flag is None:
+            # Env typos must not kill a multi-host launch — warn and skip.
+            get_logger().warning(
+                "%s: unknown sanitizer %r (valid: %s) — skipped",
+                var, name, ", ".join(_FLAG_MAP),
+            )
+            continue
+        jax.config.update(flag, True)
+        enabled.append(name)
+    if enabled:
+        get_logger().info("sanitizers enabled: %s", ", ".join(enabled))
+    return bool(enabled)
+
+
+@contextlib.contextmanager
+def strict_donation() -> Iterator[None]:
+    """Escalate 'donated buffer could not be aliased' warnings to errors.
+
+    A donation that silently falls back to a copy doubles the train state's
+    HBM footprint — exactly the class of silent perf/memory hazard the
+    sanitizer tier exists to surface.
+    """
+    with warnings.catch_warnings():
+        warnings.filterwarnings(
+            "error", message=".*[Dd]onat.*", category=UserWarning
+        )
+        yield

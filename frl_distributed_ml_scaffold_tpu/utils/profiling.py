@@ -1,0 +1,92 @@
+"""Tracing / profiling (SURVEY C19, §5 "Tracing/profiling").
+
+The reference's torch.profiler+NVTX tier maps to three TPU-native tools:
+
+- **Step-window traces**: ``WindowProfiler`` wraps a window of training
+  steps in ``jax.profiler.start_trace``/``stop_trace``, producing a
+  TensorBoard-loadable trace (XLA ops, fusion boundaries, ICI collectives,
+  host dispatch) under ``<workdir>/<name>/trace/``. Configured via
+  ``trainer.profile_start_step`` / ``trainer.profile_steps`` — zero-cost
+  when disabled, no code changes to profile a run.
+- **Host-loop annotations**: ``annotate("load_batch")`` wraps host-side
+  phases in ``jax.profiler.TraceAnnotation`` so loader stalls are visible
+  between device steps in the same trace.
+- **HLO dumps**: ``hlo_dump_flags(dir)`` returns the ``XLA_FLAGS`` string
+  that makes XLA write optimized HLO per compilation — compile-time
+  inspection (fusion decisions, layout choices). Must be in the environment
+  before the backend initializes; the launcher threads it through.
+
+Process-0 gating matches the logging tier: traces are only captured on the
+primary process (each host profiles its own devices; one trace is what the
+TensorBoard workflow wants).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Iterator
+
+import jax
+
+from frl_distributed_ml_scaffold_tpu.utils.logging import get_logger, is_primary_process
+
+
+class WindowProfiler:
+    """Capture a ``jax.profiler`` trace for steps [start, start+steps).
+
+    Call ``step_start(step)`` at the top of each loop iteration and
+    ``stop()`` after the loop (covers runs shorter than the window). The
+    window boundaries are host-side; the trace still contains the full
+    async device timeline for those steps because dispatch happens inside
+    the window.
+    """
+
+    def __init__(self, trace_dir: str, start_step: int, num_steps: int):
+        self.trace_dir = trace_dir
+        self.start_step = start_step
+        self.num_steps = num_steps
+        self._active = False
+        self._done = num_steps <= 0 or not is_primary_process()
+
+    @property
+    def enabled(self) -> bool:
+        return self.num_steps > 0
+
+    def step_start(self, step: int) -> None:
+        if self._done:
+            return
+        if not self._active and step >= self.start_step:
+            os.makedirs(self.trace_dir, exist_ok=True)
+            jax.profiler.start_trace(self.trace_dir)
+            self._active = True
+            get_logger().info(
+                "profiler: tracing steps %d..%d -> %s",
+                step, step + self.num_steps - 1, self.trace_dir,
+            )
+        elif self._active and step >= self.start_step + self.num_steps:
+            self.stop()
+
+    def stop(self) -> None:
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True
+            get_logger().info("profiler: trace written to %s", self.trace_dir)
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Host-loop phase annotation visible in the profiler timeline."""
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def hlo_dump_flags(dump_dir: str) -> str:
+    """XLA_FLAGS value for optimized-HLO dumps (set before backend init)."""
+    return f"--xla_dump_to={dump_dir} --xla_dump_hlo_as_text"
+
+
+def annotate_step(step: int):
+    """Named per-step annotation — groups a step's dispatch in the trace."""
+    return jax.profiler.StepTraceAnnotation("train", step_num=step)

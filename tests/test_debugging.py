@@ -1,0 +1,57 @@
+"""Sanitizer tier (SURVEY §5): the runtime checks actually fire."""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from frl_distributed_ml_scaffold_tpu.utils.debugging import (
+    sanitize,
+    sanitize_from_env,
+    strict_donation,
+)
+
+
+def test_sanitize_nans_traps():
+    with sanitize("nans"):
+        with pytest.raises(FloatingPointError):
+            jnp.zeros(4) / jnp.zeros(4)  # 0/0 -> NaN trap
+    # flag restored on exit
+    assert not getattr(jax.config, "jax_debug_nans")
+
+
+def test_sanitize_restores_on_error():
+    try:
+        with sanitize("leaks"):
+            assert getattr(jax.config, "jax_check_tracer_leaks")
+            raise RuntimeError("boom")
+    except RuntimeError:
+        pass
+    assert not getattr(jax.config, "jax_check_tracer_leaks")
+
+
+def test_sanitize_rejects_unknown_name():
+    with pytest.raises(KeyError):
+        with sanitize("racez"):
+            pass
+
+
+def test_sanitize_from_env(monkeypatch):
+    monkeypatch.setenv("FRL_TPU_SANITIZE", "leaks")
+    try:
+        assert sanitize_from_env()
+        assert getattr(jax.config, "jax_check_tracer_leaks")
+    finally:
+        jax.config.update("jax_check_tracer_leaks", False)
+    monkeypatch.setenv("FRL_TPU_SANITIZE", "")
+    assert not sanitize_from_env()
+
+
+def test_strict_donation_passes_clean_code():
+    with strict_donation():
+        f = jax.jit(lambda x: x + 1, donate_argnums=0)
+        x = jnp.ones(8)
+        f(x)

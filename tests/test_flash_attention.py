@@ -93,6 +93,40 @@ def test_fallback_on_untileable_shapes():
     np.testing.assert_allclose(out, ref, atol=1e-6)
 
 
+def test_sharded_flash_matches_dense():
+    """Under a live mesh the wrapper runs the kernel inside shard_map over
+    the batch + TP-head axes — per-(b,h) local, no gather (the review-flagged
+    multi-device cliff). Verified against dense on the 8-device CPU mesh."""
+    import jax
+
+    from frl_distributed_ml_scaffold_tpu.config.schema import MeshConfig
+    from frl_distributed_ml_scaffold_tpu.dist.mesh import build_mesh, mesh_context
+
+    env = build_mesh(MeshConfig(data=4, model=2))
+    q, k, v = _qkv(b=4, t=128, h=2, d=32)
+    ref = dense_attention(q, k, v, causal=True)
+    with mesh_context(env):
+        out = jax.jit(
+            lambda q, k, v: flash_attention(
+                q, k, v, causal=True, block_q=64, block_k=64, interpret=True
+            )
+        )(q, k, v)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_sharded_flash_rejects_seq_axis():
+    import pytest as _pytest
+
+    from frl_distributed_ml_scaffold_tpu.config.schema import MeshConfig
+    from frl_distributed_ml_scaffold_tpu.dist.mesh import build_mesh, mesh_context
+
+    env = build_mesh(MeshConfig(data=2, seq=4))
+    q, k, v = _qkv(b=4, t=128, h=2, d=32)
+    with mesh_context(env):
+        with _pytest.raises(ValueError, match="ring"):
+            flash_attention(q, k, v, causal=True, interpret=True)
+
+
 def test_gpt_model_flash_attention_path(tmp_path):
     """attention='flash' trains end-to-end (tiny GPT).
 
