@@ -164,6 +164,8 @@ class DataConfig:
     shuffle_seed: int = 0
     # For real datasets: directory to look in; synthetic fallback if absent.
     data_dir: Optional[str] = None
+    # Batches built ahead on a background thread (0 = synchronous).
+    prefetch: int = 2
 
 
 # --------------------------------------------------------------------------

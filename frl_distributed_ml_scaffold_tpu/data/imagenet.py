@@ -24,6 +24,7 @@ class ImageNet:
         self.cfg = cfg
         self._fallback = None
         self._shards = None
+        self._train = split == "train"
         if cfg.data_dir:
             xs = sorted(glob.glob(os.path.join(cfg.data_dir, f"{split}_images_*.npy")))
             ys = sorted(glob.glob(os.path.join(cfg.data_dir, f"{split}_labels_*.npy")))
@@ -45,13 +46,34 @@ class ImageNet:
     def batch(self, step: int, batch_size: int, host_offset: int = 0) -> dict:
         if self._fallback is not None:
             return self._fallback.batch(step, batch_size, host_offset)
+        from frl_distributed_ml_scaffold_tpu.data import native
+
         rng = np.random.default_rng((self._seed, step, host_offset))
         idx = np.sort(rng.integers(0, self._n, size=batch_size))
         shard_ids = np.searchsorted(self._offsets, idx, side="right") - 1
-        x = np.stack(
-            [
-                np.asarray(self._shards[s][i - self._offsets[s]], dtype=np.float32)
-                for s, i in zip(shard_ids, idx)
-            ]
+        # Per-shard native gather: the parallel memcpy is where the mmap
+        # page faults happen (SURVEY §7 hard part 5).
+        shape = self._shards[0].shape[1:]
+        size = self.cfg.image_size
+        if min(shape[0], shape[1]) < size:
+            raise ValueError(
+                f"stored shards are {shape[0]}x{shape[1]} but "
+                f"data.image_size={size}; shards must be stored at >= the "
+                "model input size"
+            )
+        x = np.empty((batch_size,) + shape, np.float32)
+        for s in np.unique(shard_ids):
+            mask = shard_ids == s
+            x[mask] = native.gather_rows(
+                self._shards[s], idx[mask] - self._offsets[s]
+            )
+        # Always through the augment kernel: normalize + (train) flip apply
+        # even when stored size == input size — storage size must never
+        # change training statistics. Larger storage adds the random crop.
+        x = native.augment_batch(
+            x,
+            size,
+            seed=hash((self._seed, step, host_offset)) & (2**63 - 1),
+            train=self._train,
         )
         return {"image": x, "label": self._y[idx]}

@@ -1,0 +1,218 @@
+"""ctypes bindings for the native data-loader core (SURVEY C16).
+
+Loads ``native/libfrl_data.so`` (building it from ``native/frl_data.cpp``
+with g++ on first use, cached by source mtime). Every entry point has a
+pure-numpy fallback with identical semantics, so environments without a
+toolchain degrade gracefully — ``native_available()`` reports which path is
+live, and the parity tests assert C++ == numpy bit-for-bit where the
+contract is exact (gather) and distributionally where it involves RNG.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+from frl_distributed_ml_scaffold_tpu.utils.logging import get_logger
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
+_SRC = os.path.join(_NATIVE_DIR, "frl_data.cpp")
+_LIB = os.path.join(_NATIVE_DIR, "libfrl_data.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _build() -> bool:
+    # Compile to a process-unique temp path and rename into place: rename is
+    # atomic on POSIX, so concurrent first-use builds (multi-process launch,
+    # shared filesystem) can never load a torn .so.
+    tmp = f"{_LIB}.{os.getpid()}.tmp"
+    cmd = [
+        "g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
+        "-pthread", _SRC, "-o", tmp,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _LIB)
+        return True
+    except (subprocess.SubprocessError, FileNotFoundError, OSError) as e:
+        get_logger().warning(
+            "native data core build failed (%s); using numpy fallback", e
+        )
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("FRL_TPU_NO_NATIVE"):
+            return None
+        # A lib shipped without its source is simply trusted (no mtime to
+        # compare against) — graceful degradation must not raise.
+        stale = not os.path.exists(_LIB) or (
+            os.path.exists(_SRC)
+            and os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+        )
+        if stale and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError as e:
+            get_logger().warning("native data core load failed (%s)", e)
+            return None
+        f64 = ctypes.POINTER(ctypes.c_float)
+        i64 = ctypes.POINTER(ctypes.c_int64)
+        i32 = ctypes.POINTER(ctypes.c_int32)
+        u8 = ctypes.POINTER(ctypes.c_uint8)
+        lib.frl_gather_rows.argtypes = [f64, i64, f64, ctypes.c_int64,
+                                        ctypes.c_int64]
+        lib.frl_gather_rows_u8.argtypes = [u8, i64, f64, ctypes.c_int64,
+                                           ctypes.c_int64]
+        lib.frl_augment_batch.argtypes = [
+            f64, f64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_uint64, ctypes.c_int,
+            f64, f64,
+        ]
+        lib.frl_synth_images.argtypes = [
+            f64, i32, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_uint64, ctypes.c_float,
+        ]
+        lib.frl_version.restype = ctypes.c_int
+        _lib = lib
+        get_logger().info("native data core loaded (v%d)", lib.frl_version())
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def _fptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def gather_rows(src: np.ndarray, idx: np.ndarray) -> np.ndarray:
+    """dst[i] = src[idx[i]] as float32 (row = trailing dims).
+
+    ``src`` is typically an ``np.load(mmap_mode="r")`` shard, used zero-copy
+    (an ``ascontiguousarray`` here would fault the entire mmap into RAM);
+    the parallel per-row copy is where the page faults happen, across the
+    worker pool. float32 rows are memcpy'd; uint8 rows convert + scale to
+    [0, 1] in the same pass. Other dtypes take the numpy fallback.
+    """
+    idx = np.ascontiguousarray(idx, dtype=np.int64)
+    lib = _load()
+    u8 = src.dtype == np.uint8
+    if lib is None or not src.flags["C_CONTIGUOUS"] or (
+        src.dtype != np.float32 and not u8
+    ):
+        out = np.ascontiguousarray(src[idx], dtype=np.float32)
+        return out / np.float32(255.0) if u8 else out
+    out = np.empty((len(idx),) + src.shape[1:], np.float32)
+    row = int(np.prod(src.shape[1:], dtype=np.int64))
+    iptr = idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+    if u8:
+        # uint8 shards convert + scale to [0,1] in the gather pass itself.
+        lib.frl_gather_rows_u8(
+            src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), iptr,
+            _fptr(out), len(idx), row,
+        )
+    else:
+        lib.frl_gather_rows(_fptr(src), iptr, _fptr(out), len(idx), row)
+    return out
+
+
+_IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+_IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+
+
+def augment_batch(
+    x: np.ndarray,
+    crop: int,
+    *,
+    seed: int,
+    train: bool,
+    mean: np.ndarray = _IMAGENET_MEAN,
+    std: np.ndarray = _IMAGENET_STD,
+) -> np.ndarray:
+    """NHWC random-crop(+flip)+normalize (train) / center-crop (eval)."""
+    n, h, w, c = x.shape
+    mean = np.ascontiguousarray(np.broadcast_to(mean, (c,)), np.float32)
+    std = np.ascontiguousarray(np.broadcast_to(std, (c,)), np.float32)
+    lib = _load()
+    if lib is None:
+        return _augment_numpy(x, crop, seed=seed, train=train, mean=mean, std=std)
+    x = np.ascontiguousarray(x, np.float32)
+    out = np.empty((n, crop, crop, c), np.float32)
+    lib.frl_augment_batch(
+        _fptr(x), _fptr(out), n, h, w, c, crop,
+        ctypes.c_uint64(seed & (2**64 - 1)), int(train), _fptr(mean),
+        _fptr(std),
+    )
+    return out
+
+
+def _augment_numpy(x, crop, *, seed, train, mean, std):
+    n, h, w, c = x.shape
+    rng = np.random.default_rng(seed)
+    out = np.empty((n, crop, crop, c), np.float32)
+    for i in range(n):
+        if train:
+            y0 = rng.integers(0, h - crop + 1) if h > crop else 0
+            x0 = rng.integers(0, w - crop + 1) if w > crop else 0
+            patch = x[i, y0:y0 + crop, x0:x0 + crop]
+            if rng.random() < 0.5:
+                patch = patch[:, ::-1]
+        else:
+            y0, x0 = (h - crop) // 2, (w - crop) // 2
+            patch = x[i, y0:y0 + crop, x0:x0 + crop]
+        out[i] = (patch - mean) / std
+    return out
+
+
+def synth_images(
+    labels: np.ndarray, h: int, w: int, c: int, *, seed: int,
+    noise: float = 0.25,
+) -> np.ndarray:
+    """Deterministic class-prototype images (see C++ for the field)."""
+    labels = np.ascontiguousarray(labels, np.int32)
+    n = len(labels)
+    lib = _load()
+    if lib is None:
+        return _synth_numpy(labels, h, w, c, seed=seed, noise=noise)
+    out = np.empty((n, h, w, c), np.float32)
+    lib.frl_synth_images(
+        _fptr(out), labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        n, h, w, c, ctypes.c_uint64(seed & (2**64 - 1)),
+        ctypes.c_float(noise),
+    )
+    return out
+
+
+def _synth_numpy(labels, h, w, c, *, seed, noise):
+    n = len(labels)
+    ys = np.arange(h, dtype=np.float32)[:, None, None]
+    xs = np.arange(w, dtype=np.float32)[None, :, None]
+    ch = np.arange(c, dtype=np.float32)[None, None, :]
+    out = np.empty((n, h, w, c), np.float32)
+    rng = np.random.default_rng(seed)
+    for i, label in enumerate(labels):
+        fy, fx, ph = 1.0 + label % 7, 1.0 + label % 5, 0.37 * (label % 11)
+        base = np.sin(fy * ys * 2 * np.pi / h + ph + ch) * np.cos(
+            fx * xs * 2 * np.pi / w + ph
+        )
+        out[i] = 0.5 * base + noise * (rng.random((h, w, c), np.float32) - 0.5)
+    return out

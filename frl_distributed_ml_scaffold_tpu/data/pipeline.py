@@ -107,5 +107,61 @@ class DataPipeline:
             step += 1
 
 
-def build_pipeline(cfg: DataConfig, env: MeshEnv, split: str = "train") -> DataPipeline:
-    return DataPipeline(cfg, env, split=split)
+class PrefetchingPipeline:
+    """Builds batches ahead of the consumer on a background worker.
+
+    The reference's DataLoader-worker-pool equivalent, adapted to the
+    step-indexed pull model: batches stay pure functions of step (exact
+    resume is preserved — a prefetched-but-unconsumed batch is simply
+    rebuilt after restart), while host-side batch assembly (native gather/
+    augment/synthesis + device transfer) overlaps the previous device step.
+    One worker is enough: batch assembly need only be faster than the
+    compiled step, not parallel with itself, and a single worker keeps
+    device-transfer ordering deterministic.
+    """
+
+    def __init__(self, pipeline: DataPipeline, depth: int = 2):
+        import concurrent.futures
+
+        self._p = pipeline
+        self._depth = max(1, depth)
+        self._futures: dict[int, concurrent.futures.Future] = {}
+        self._ex = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="frl-data-prefetch"
+        )
+
+    # DataPipeline surface the trainer uses --------------------------------
+    @property
+    def cfg(self):
+        return self._p.cfg
+
+    @property
+    def local_batch_size(self):
+        return self._p.local_batch_size
+
+    def shardings_for(self, batch):
+        return self._p.shardings_for(batch)
+
+    def global_batch(self, step: int) -> dict[str, jax.Array]:
+        # Resume/seek: drop stale prefetches from another step range.
+        stale = [s for s in self._futures if s < step or s > step + self._depth]
+        for s in stale:
+            self._futures.pop(s).cancel()
+        fut = self._futures.pop(step, None)
+        for s in range(step + 1, step + 1 + self._depth):
+            if s not in self._futures:
+                self._futures[s] = self._ex.submit(self._p.global_batch, s)
+        return fut.result() if fut is not None else self._p.global_batch(step)
+
+    def __iter__(self) -> Iterator[dict[str, jax.Array]]:
+        step = 0
+        while True:
+            yield self.global_batch(step)
+            step += 1
+
+
+def build_pipeline(cfg: DataConfig, env: MeshEnv, split: str = "train"):
+    pipeline = DataPipeline(cfg, env, split=split)
+    if split == "train" and cfg.prefetch > 0:
+        return PrefetchingPipeline(pipeline, depth=cfg.prefetch)
+    return pipeline
