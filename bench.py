@@ -30,7 +30,9 @@ def bench_config(name: str, overrides: list[str], *, steps: int, warmup: int):
     from frl_distributed_ml_scaffold_tpu.trainer.loop import Trainer
     from frl_distributed_ml_scaffold_tpu.utils.timing import StepTimer
 
-    cfg = apply_overrides(get_config(name), overrides)
+    # prefetch=0: the benchmark reuses one device-resident batch; background
+    # prefetch would only add host/device contention inside timed windows.
+    cfg = apply_overrides(get_config(name), ["data.prefetch=0"] + overrides)
     trainer = Trainer(cfg)
     state = trainer.init_state()
     # One device-resident batch, reused (global_batch returns sharded
@@ -51,10 +53,83 @@ def bench_config(name: str, overrides: list[str], *, steps: int, warmup: int):
     perf = timer.summary(cfg.data.global_batch_size)
     if "samples_per_sec_per_chip" not in perf:
         raise RuntimeError(f"benchmark produced no timed windows: {perf}")
+    perf["_record"] = protocol_record(cfg, trainer, perf)
     return perf
 
 
+def protocol_record(cfg, trainer, perf) -> dict:
+    """The BASELINE.md measurement-protocol record (one JSONL line/run)."""
+    import jax
+
+    n_chips = jax.device_count()
+    dev = jax.devices()[0]
+    return {
+        "config": cfg.name,
+        "model": getattr(cfg.model, "family", type(cfg.model).__name__),
+        "global_batch_size": cfg.data.global_batch_size,
+        "per_chip_batch_size": cfg.data.global_batch_size // n_chips,
+        "mesh": dict(trainer.env.mesh.shape),
+        "param_sharding": cfg.parallel.param_sharding,
+        "precision": cfg.precision.policy,
+        "grad_accum": cfg.trainer.grad_accum,
+        "remat": cfg.trainer.remat,
+        "n_chips": n_chips,
+        "chip": getattr(dev, "device_kind", str(dev)),
+        "steps_per_sec": round(perf["steps_per_sec"], 4),
+        "samples_per_sec_per_chip": round(perf["samples_per_sec_per_chip"], 2),
+        "step_time_median_s": round(perf["step_time_median_s"], 6),
+        "step_time_p90_s": round(perf["step_time_p90_s"], 6),
+    }
+
+
+# The five BASELINE configs, sized for one v5e chip (shrunk only where the
+# full model cannot fit / compile on a single chip; recorded in overrides so
+# the emitted protocol line says exactly what ran).
+ALL_CONFIGS = [
+    ("mnist_mlp", ["data.global_batch_size=1024"], 50),
+    ("imagenet_rn50_ddp", ["data.global_batch_size=512"], 20),
+    ("imagenet_vitb_fsdp", ["data.global_batch_size=256"], 20),
+    (
+        "gpt2_medium_zero1",
+        ["data.global_batch_size=8", "trainer.grad_accum=1",
+         "model.attention=flash"],
+        10,
+    ),
+    ("ego4d_video_elastic", ["data.global_batch_size=32",
+                             "checkpoint.enabled=false"], 10),
+]
+
+
+def run_all(out_path: str = "BENCH_TABLE.jsonl") -> int:
+    """Benchmark every BASELINE config; emit protocol JSONL + a table."""
+    rows = []
+    with open(out_path, "w") as fh:
+        for name, overrides, steps in ALL_CONFIGS:
+            try:
+                perf = bench_config(
+                    name, overrides + ["trainer.log_every=1000000"],
+                    steps=steps, warmup=2,
+                )
+                rec = perf["_record"]
+            except Exception as e:  # record the failure, keep benching
+                rec = {"config": name, "error": str(e)[:300]}
+            rows.append(rec)
+            fh.write(json.dumps(rec) + "\n")
+            fh.flush()
+            print(json.dumps(rec))
+    ok = [r for r in rows if "error" not in r]
+    print(f"\n{'config':28s} {'samples/s/chip':>14s} {'step_ms':>9s}  mesh")
+    for r in ok:
+        print(
+            f"{r['config']:28s} {r['samples_per_sec_per_chip']:14.1f} "
+            f"{r['step_time_median_s']*1e3:9.2f}  {r['mesh']}"
+        )
+    return 0 if len(ok) == len(rows) else 1
+
+
 def main() -> int:
+    if "--all" in sys.argv:
+        return run_all()
     candidates = [
         (
             "rn50_imagenet_samples_per_sec_per_chip",

@@ -75,7 +75,6 @@ def _load() -> ctypes.CDLL | None:
             return None
         f64 = ctypes.POINTER(ctypes.c_float)
         i64 = ctypes.POINTER(ctypes.c_int64)
-        i32 = ctypes.POINTER(ctypes.c_int32)
         u8 = ctypes.POINTER(ctypes.c_uint8)
         lib.frl_gather_rows.argtypes = [f64, i64, f64, ctypes.c_int64,
                                         ctypes.c_int64]
@@ -85,10 +84,6 @@ def _load() -> ctypes.CDLL | None:
             f64, f64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
             ctypes.c_int64, ctypes.c_int64, ctypes.c_uint64, ctypes.c_int,
             f64, f64,
-        ]
-        lib.frl_synth_images.argtypes = [
-            f64, i32, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
-            ctypes.c_int64, ctypes.c_uint64, ctypes.c_float,
         ]
         lib.frl_version.restype = ctypes.c_int
         _lib = lib
@@ -150,6 +145,10 @@ def augment_batch(
 ) -> np.ndarray:
     """NHWC random-crop(+flip)+normalize (train) / center-crop (eval)."""
     n, h, w, c = x.shape
+    if crop > h or crop > w:
+        # Validated here so both code paths fail identically — the native
+        # kernel would otherwise read out of bounds where numpy raises.
+        raise ValueError(f"crop {crop} exceeds stored image size {h}x{w}")
     mean = np.ascontiguousarray(np.broadcast_to(mean, (c,)), np.float32)
     std = np.ascontiguousarray(np.broadcast_to(std, (c,)), np.float32)
     lib = _load()
@@ -183,36 +182,3 @@ def _augment_numpy(x, crop, *, seed, train, mean, std):
     return out
 
 
-def synth_images(
-    labels: np.ndarray, h: int, w: int, c: int, *, seed: int,
-    noise: float = 0.25,
-) -> np.ndarray:
-    """Deterministic class-prototype images (see C++ for the field)."""
-    labels = np.ascontiguousarray(labels, np.int32)
-    n = len(labels)
-    lib = _load()
-    if lib is None:
-        return _synth_numpy(labels, h, w, c, seed=seed, noise=noise)
-    out = np.empty((n, h, w, c), np.float32)
-    lib.frl_synth_images(
-        _fptr(out), labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-        n, h, w, c, ctypes.c_uint64(seed & (2**64 - 1)),
-        ctypes.c_float(noise),
-    )
-    return out
-
-
-def _synth_numpy(labels, h, w, c, *, seed, noise):
-    n = len(labels)
-    ys = np.arange(h, dtype=np.float32)[:, None, None]
-    xs = np.arange(w, dtype=np.float32)[None, :, None]
-    ch = np.arange(c, dtype=np.float32)[None, None, :]
-    out = np.empty((n, h, w, c), np.float32)
-    rng = np.random.default_rng(seed)
-    for i, label in enumerate(labels):
-        fy, fx, ph = 1.0 + label % 7, 1.0 + label % 5, 0.37 * (label % 11)
-        base = np.sin(fy * ys * 2 * np.pi / h + ph + ch) * np.cos(
-            fx * xs * 2 * np.pi / w + ph
-        )
-        out[i] = 0.5 * base + noise * (rng.random((h, w, c), np.float32) - 0.5)
-    return out

@@ -126,9 +126,7 @@ class PrefetchingPipeline:
         self._p = pipeline
         self._depth = max(1, depth)
         self._futures: dict[int, concurrent.futures.Future] = {}
-        self._ex = concurrent.futures.ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix="frl-data-prefetch"
-        )
+        self._ex: concurrent.futures.ThreadPoolExecutor | None = None
 
     # DataPipeline surface the trainer uses --------------------------------
     @property
@@ -143,6 +141,12 @@ class PrefetchingPipeline:
         return self._p.shardings_for(batch)
 
     def global_batch(self, step: int) -> dict[str, jax.Array]:
+        import concurrent.futures
+
+        if self._ex is None:  # re-open after close() (Trainer.fit re-entry)
+            self._ex = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="frl-data-prefetch"
+            )
         # Resume/seek: drop stale prefetches from another step range.
         stale = [s for s in self._futures if s < step or s > step + self._depth]
         for s in stale:
@@ -152,6 +156,17 @@ class PrefetchingPipeline:
             if s not in self._futures:
                 self._futures[s] = self._ex.submit(self._p.global_batch, s)
         return fut.result() if fut is not None else self._p.global_batch(step)
+
+    def close(self) -> None:
+        """Cancel in-flight work and release the worker thread. Trainer.fit
+        calls this on exit; the pipeline transparently re-opens if used
+        again."""
+        for fut in self._futures.values():
+            fut.cancel()
+        self._futures.clear()
+        if self._ex is not None:
+            self._ex.shutdown(wait=False, cancel_futures=True)
+            self._ex = None
 
     def __iter__(self) -> Iterator[dict[str, jax.Array]]:
         step = 0

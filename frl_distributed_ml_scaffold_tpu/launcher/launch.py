@@ -37,6 +37,11 @@ def _parse_args(argv=None):
         help="run under the elastic checkpoint-restart supervisor (SURVEY C14)",
     )
     p.add_argument(
+        "--eval-only",
+        action="store_true",
+        help="restore the latest checkpoint and run the eval loop only",
+    )
+    p.add_argument(
         "--coordinator", default=None, help="host:port for multi-host bring-up"
     )
     p.add_argument(
@@ -126,9 +131,27 @@ def main(argv=None) -> int:
     sanitize_from_env()  # FRL_TPU_SANITIZE=nans,infs,leaks (SURVEY §5)
     logger = get_logger()
     logger.info("launching %s\n%s", cfg.name, pretty_config(cfg))
-    _, last = run_experiment(cfg)
+    if args.eval_only:
+        last = run_eval(cfg)
+    else:
+        _, last = run_experiment(cfg)
     logger.info("done: %s", json.dumps(last, default=str))
     return 0
+
+
+def run_eval(cfg) -> dict:
+    """Reference call stack (e): restore → eval loop, no training."""
+    from frl_distributed_ml_scaffold_tpu.trainer.loop import Trainer
+
+    _assert_no_cuda_imports()
+    trainer = Trainer(cfg)
+    if trainer.checkpointer is None or trainer.checkpointer.latest_step() is None:
+        raise RuntimeError(
+            "--eval-only needs checkpoint.enabled=true and an existing "
+            f"checkpoint under {cfg.workdir}/{cfg.name}/ckpt"
+        )
+    state = trainer.checkpointer.restore_or_init(trainer)
+    return trainer.evaluate(state)
 
 
 if __name__ == "__main__":

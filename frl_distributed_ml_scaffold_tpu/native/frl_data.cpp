@@ -68,7 +68,10 @@ class Pool {
     run(*task);  // the caller participates too — no idle producer
     std::unique_lock<std::mutex> lk(m_);
     finished_cv_.wait(lk, [&] { return task->done.load() >= task->total; });
-    current_.reset();
+    // Another caller (prefetch worker vs. eval path) may have published its
+    // own task meanwhile — only clear the slot if it is still ours, or its
+    // batch would silently run single-threaded.
+    if (current_ == task) current_.reset();
   }
 
  private:
@@ -210,32 +213,6 @@ void frl_augment_batch(const float* in, float* out, int64_t n, int64_t h,
   });
 }
 
-// Synthetic class-prototype images: deterministic in (seed, label, pixel)
-// — class structure a model can actually learn, generated at memory speed.
-// out is NHWC float32; prototype = smooth per-class sinusoid field, plus
-// uniform noise.
-void frl_synth_images(float* out, const int32_t* labels, int64_t n,
-                      int64_t h, int64_t w, int64_t c, uint64_t seed,
-                      float noise) {
-  Pool::instance().parallel_for(n, [&](int64_t i) {
-    uint64_t s = seed ^ (0x9e3779b97f4a7c15ULL * (uint64_t)(i + 1));
-    int32_t label = labels[i];
-    float fy = 1.0f + (label % 7), fx = 1.0f + (label % 5),
-          ph = 0.37f * (label % 11);
-    float* dst = out + i * h * w * c;
-    for (int64_t y = 0; y < h; ++y) {
-      for (int64_t x = 0; x < w; ++x) {
-        for (int64_t ch = 0; ch < c; ++ch) {
-          float base = __builtin_sinf(fy * y * 6.2831853f / h + ph + ch) *
-                       __builtin_cosf(fx * x * 6.2831853f / w + ph);
-          dst[(y * w + x) * c + ch] =
-              0.5f * base + noise * (uniform01(s) - 0.5f);
-        }
-      }
-    }
-  });
-}
-
-int frl_version() { return 1; }
+int frl_version() { return 2; }
 
 }  // extern "C"

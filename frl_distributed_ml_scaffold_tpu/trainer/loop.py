@@ -261,6 +261,8 @@ class Trainer:
             # release the process-wide profiler) — the crash run is exactly
             # when the trace is wanted.
             profiler.stop()
+            if hasattr(self.pipeline, "close"):
+                self.pipeline.close()  # stop prefetch worker + in-flight work
         if self.checkpointer is not None:
             if total % cfg.checkpoint.save_every != 0:
                 # Final state not yet covered by the periodic save above.

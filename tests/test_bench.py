@@ -1,0 +1,41 @@
+"""Benchmark harness: protocol record completeness (BASELINE.md §protocol)."""
+
+from __future__ import annotations
+
+import json
+import sys
+import os
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench
+
+
+def test_bench_config_emits_protocol_record():
+    perf = bench.bench_config(
+        "mnist_mlp",
+        ["data.global_batch_size=64", "trainer.log_every=1000000"],
+        steps=4,
+        warmup=1,
+    )
+    rec = perf["_record"]
+    for key in (
+        "config", "model", "global_batch_size", "per_chip_batch_size",
+        "mesh", "param_sharding", "precision", "n_chips", "chip",
+        "steps_per_sec", "samples_per_sec_per_chip", "step_time_median_s",
+        "step_time_p90_s",
+    ):
+        assert key in rec, f"protocol record missing {key}"
+    assert rec["samples_per_sec_per_chip"] > 0
+    assert rec["per_chip_batch_size"] * rec["n_chips"] == 64
+
+
+def test_run_all_writes_jsonl(tmp_path, monkeypatch):
+    monkeypatch.setattr(
+        bench, "ALL_CONFIGS",
+        [("mnist_mlp", ["data.global_batch_size=64"], 4)],
+    )
+    out = tmp_path / "table.jsonl"
+    assert bench.run_all(str(out)) == 0
+    lines = [json.loads(l) for l in out.read_text().splitlines()]
+    assert len(lines) == 1 and lines[0]["config"] == "mnist_mlp"

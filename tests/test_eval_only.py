@@ -1,0 +1,32 @@
+"""--eval-only entrypoint (reference call stack (e): restore → eval)."""
+
+from __future__ import annotations
+
+import pytest
+
+from launch import main
+
+
+def test_eval_only_roundtrip(tmp_path):
+    common = [
+        "--config=mnist_mlp",
+        "--device=cpu",
+        "data.global_batch_size=64",
+        "checkpoint.enabled=true",
+        f"workdir={tmp_path}",
+    ]
+    assert (
+        main(common + ["trainer.total_steps=8", "checkpoint.save_every=8",
+                       "trainer.log_every=4"])
+        == 0
+    )
+    assert main(common + ["--eval-only"]) == 0
+
+
+def test_eval_only_without_checkpoint_errors(tmp_path):
+    with pytest.raises(RuntimeError, match="eval-only"):
+        main([
+            "--config=mnist_mlp", "--device=cpu", "--eval-only",
+            "data.global_batch_size=64", "checkpoint.enabled=true",
+            f"workdir={tmp_path}",
+        ])
