@@ -11,18 +11,53 @@ absent — see BASELINE.md); the comparison constant below is the documented
 *assumed* A100-DDP ResNet-50 figure (2500 samples/sec/chip, bf16) so the
 ratio is meaningful the day real numbers surface. Target from the north
 star: >= 0.9 * A100 -> vs_baseline >= 0.9.
+
+Watchdog design (round-2, after BENCH_r01 rc=124): the experimental axon
+TPU relay can hang in backend bring-up indefinitely. Every stage that can
+touch a device runs in a BOUNDED subprocess:
+
+  1. probe: ``jax.devices()`` under a hard timeout — if the relay is down
+     we find out in ``PROBE_TIMEOUT_S``, not 25 silent minutes;
+  2. each candidate benchmark: its own subprocess + timeout, result handed
+     back as a ``RESULT {json}`` line.
+
+Whatever happens — TPU up, TPU down, compile hang — the parent ALWAYS
+prints exactly one final JSON line to stdout; progress/diagnostics go to
+stderr, flushed.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
+import time
 
 # Assumed reference numbers (documented stand-ins; see module docstring).
 ASSUMED_BASELINE = {
     "rn50_imagenet_samples_per_sec_per_chip": 2500.0,
     "mnist_mlp_samples_per_sec_per_chip": 100000.0,
 }
+
+# Dense bf16 peak FLOP/s per chip, by jax device_kind (for MFU). CPU and
+# unknown chips report no MFU rather than a made-up one.
+CHIP_PEAK_FLOPS = {
+    "TPU v5 lite": 197e12,  # v5e
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,  # v5p
+    "TPU v5p": 459e12,
+    "TPU v4": 275e12,
+    "TPU v6 lite": 918e12,  # v6e / Trillium
+    "TPU v6e": 918e12,
+}
+
+PROBE_TIMEOUT_S = int(os.environ.get("FRL_BENCH_PROBE_TIMEOUT_S", "240"))
+CANDIDATE_TIMEOUT_S = int(os.environ.get("FRL_BENCH_CANDIDATE_TIMEOUT_S", "720"))
+
+
+def _progress(msg: str) -> None:
+    print(f"[bench {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr, flush=True)
 
 
 def bench_config(name: str, overrides: list[str], *, steps: int, warmup: int):
@@ -39,6 +74,10 @@ def bench_config(name: str, overrides: list[str], *, steps: int, warmup: int):
     # jax.Arrays): the benchmark measures the chip (fwd+bwd+update), not the
     # host loader (BASELINE.md protocol).
     batch = trainer.pipeline.global_batch(0)
+    # FLOPs of one compiled step, from XLA's own cost model (counts every op
+    # the step actually runs: fwd+bwd+optimizer, all grad-accum microbatches).
+    cost = trainer.step_cost_analysis(state, batch)
+    step_flops = float(cost.get("flops", 0.0)) if cost else 0.0
     # Windowed timing: sync on the loss once per window, steps inside a
     # window pipeline as in a real training loop (per-step syncs would
     # charge the host<->device round-trip latency to every step).
@@ -53,17 +92,18 @@ def bench_config(name: str, overrides: list[str], *, steps: int, warmup: int):
     perf = timer.summary(cfg.data.global_batch_size)
     if "samples_per_sec_per_chip" not in perf:
         raise RuntimeError(f"benchmark produced no timed windows: {perf}")
-    perf["_record"] = protocol_record(cfg, trainer, perf)
+    perf["_record"] = protocol_record(cfg, trainer, perf, step_flops=step_flops)
     return perf
 
 
-def protocol_record(cfg, trainer, perf) -> dict:
+def protocol_record(cfg, trainer, perf, *, step_flops: float = 0.0) -> dict:
     """The BASELINE.md measurement-protocol record (one JSONL line/run)."""
     import jax
 
     n_chips = jax.device_count()
     dev = jax.devices()[0]
-    return {
+    kind = getattr(dev, "device_kind", str(dev))
+    rec = {
         "config": cfg.name,
         "model": getattr(cfg.model, "family", type(cfg.model).__name__),
         "global_batch_size": cfg.data.global_batch_size,
@@ -74,12 +114,24 @@ def protocol_record(cfg, trainer, perf) -> dict:
         "grad_accum": cfg.trainer.grad_accum,
         "remat": cfg.trainer.remat,
         "n_chips": n_chips,
-        "chip": getattr(dev, "device_kind", str(dev)),
+        "chip": kind,
         "steps_per_sec": round(perf["steps_per_sec"], 4),
         "samples_per_sec_per_chip": round(perf["samples_per_sec_per_chip"], 2),
         "step_time_median_s": round(perf["step_time_median_s"], 6),
         "step_time_p90_s": round(perf["step_time_p90_s"], 6),
     }
+    if step_flops > 0:
+        rec["model_flops_per_sample"] = round(
+            step_flops / cfg.data.global_batch_size
+        )
+        peak = CHIP_PEAK_FLOPS.get(kind)
+        if peak:
+            # MFU: achieved FLOP/s over peak, per chip (flops here is the
+            # whole-step XLA count, so this is end-to-end training MFU).
+            rec["mfu"] = round(
+                step_flops * perf["steps_per_sec"] / (n_chips * peak), 4
+            )
+    return rec
 
 
 # The five BASELINE configs, sized for one v5e chip (shrunk only where the
@@ -102,9 +154,18 @@ ALL_CONFIGS = [
 
 def run_all(out_path: str = "BENCH_TABLE.jsonl") -> int:
     """Benchmark every BASELINE config; emit protocol JSONL + a table."""
+    _respect_platform_env()
+    kind, probe_err = probe_backend()
+    if probe_err is not None:
+        rec = {"config": "_probe", "error": probe_err}
+        with open(out_path, "w") as fh:
+            fh.write(json.dumps(rec) + "\n")
+        print(json.dumps(rec), flush=True)
+        return 1
     rows = []
     with open(out_path, "w") as fh:
         for name, overrides, steps in ALL_CONFIGS:
+            _progress(f"benchmarking {name} ...")
             try:
                 perf = bench_config(
                     name, overrides + ["trainer.log_every=1000000"],
@@ -118,56 +179,166 @@ def run_all(out_path: str = "BENCH_TABLE.jsonl") -> int:
             fh.flush()
             print(json.dumps(rec))
     ok = [r for r in rows if "error" not in r]
-    print(f"\n{'config':28s} {'samples/s/chip':>14s} {'step_ms':>9s}  mesh")
+    print(f"\n{'config':28s} {'samples/s/chip':>14s} {'step_ms':>9s} {'mfu':>6s}  mesh")
     for r in ok:
+        mfu = f"{r['mfu']:.3f}" if "mfu" in r else "-"
         print(
             f"{r['config']:28s} {r['samples_per_sec_per_chip']:14.1f} "
-            f"{r['step_time_median_s']*1e3:9.2f}  {r['mesh']}"
+            f"{r['step_time_median_s']*1e3:9.2f} {mfu:>6s}  {r['mesh']}"
         )
     return 0 if len(ok) == len(rows) else 1
+
+
+# Headline candidates, best first (the ladder the parent walks).
+CANDIDATES = [
+    (
+        "rn50_imagenet_samples_per_sec_per_chip",
+        "imagenet_rn50_ddp",
+        # bs=512 is the measured single-chip throughput knee (256: 1905,
+        # 512: 2025, 1024: 1842 samples/sec/chip on v5e).
+        ["data.global_batch_size=512", "trainer.log_every=1000000"],
+        20,
+    ),
+    (
+        "mnist_mlp_samples_per_sec_per_chip",
+        "mnist_mlp",
+        ["data.global_batch_size=1024", "trainer.log_every=1000000"],
+        50,
+    ),
+]
+
+
+def _candidate_result(metric: str, cfg_name: str, overrides: list[str],
+                      steps: int) -> dict:
+    perf = bench_config(cfg_name, overrides, steps=steps, warmup=3)
+    value = perf["samples_per_sec_per_chip"]
+    base = ASSUMED_BASELINE[metric]
+    rec = perf["_record"]
+    out = {
+        "metric": metric,
+        "value": round(value, 2),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(value / base, 4),
+    }
+    if "mfu" in rec:
+        out["mfu"] = rec["mfu"]
+    return out
+
+
+def _respect_platform_env() -> None:
+    """Make the JAX_PLATFORMS env var authoritative again.
+
+    The axon sitecustomize (on PYTHONPATH) pins jax_platforms at the
+    jax.config level, which beats env vars — so a subprocess launched with
+    JAX_PLATFORMS=cpu would still try TPU bring-up. Re-assert the env var
+    at the config level before any backend initializes.
+    """
+    p = os.environ.get("JAX_PLATFORMS")
+    if p:
+        import jax
+
+        jax.config.update("jax_platforms", p)
+
+
+def child_main(spec_json: str) -> int:
+    """Run ONE candidate in this (sacrificial) process; emit RESULT line."""
+    _respect_platform_env()
+    spec = json.loads(spec_json)
+    result = _candidate_result(
+        spec["metric"], spec["config"], spec["overrides"], spec["steps"]
+    )
+    print("RESULT " + json.dumps(result), flush=True)
+    return 0
+
+
+def _run_bounded(argv: list[str], timeout_s: int) -> tuple[int | None, str, str]:
+    """Run argv with a hard timeout; returns (rc, stdout, stderr).
+
+    rc=None means timeout (distinct from any real exit/signal code). The
+    child is killed (not just waited on) so a hung TPU bring-up can't
+    outlive the budget.
+    """
+    try:
+        r = subprocess.run(
+            argv, capture_output=True, text=True, timeout=timeout_s
+        )
+        return r.returncode, r.stdout, r.stderr
+    except subprocess.TimeoutExpired as e:
+        def _txt(x):
+            return x.decode(errors="replace") if isinstance(x, bytes) else (x or "")
+
+        return None, _txt(e.stdout), _txt(e.stderr)
+
+
+def probe_backend() -> tuple[str | None, str | None]:
+    """Bounded backend bring-up check. Returns (device_kind, error)."""
+    code = (
+        "import os, jax\n"
+        "p = os.environ.get('JAX_PLATFORMS')\n"
+        "if p: jax.config.update('jax_platforms', p)\n"
+        "d = jax.devices()\n"
+        "print('PROBE_OK', len(d), '|', getattr(d[0], 'device_kind', str(d[0])))"
+    )
+    t0 = time.perf_counter()
+    rc, out, err = _run_bounded([sys.executable, "-c", code], PROBE_TIMEOUT_S)
+    dt = time.perf_counter() - t0
+    if rc is None:
+        return None, (
+            f"backend init timeout after {PROBE_TIMEOUT_S}s "
+            f"(platform={os.environ.get('JAX_PLATFORMS', 'default')})"
+        )
+    if rc != 0:
+        return None, f"backend init failed rc={rc}: {err.strip()[-300:]}"
+    for line in out.splitlines():
+        if line.startswith("PROBE_OK"):
+            _progress(f"backend up in {dt:.1f}s: {line}")
+            return line.split("|", 1)[1].strip(), None
+    return None, f"probe produced no PROBE_OK line: {out[-200:]!r}"
 
 
 def main() -> int:
     if "--all" in sys.argv:
         return run_all()
-    candidates = [
-        (
-            "rn50_imagenet_samples_per_sec_per_chip",
-            "imagenet_rn50_ddp",
-            # bs=512 is the measured single-chip throughput knee (256: 1905,
-            # 512: 2025, 1024: 1842 samples/sec/chip on v5e).
-            ["data.global_batch_size=512", "trainer.log_every=1000000"],
-            20,
-        ),
-        (
-            "mnist_mlp_samples_per_sec_per_chip",
-            "mnist_mlp",
-            ["data.global_batch_size=1024", "trainer.log_every=1000000"],
-            50,
-        ),
-    ]
-    last_err = None
-    for metric, cfg_name, overrides, steps in candidates:
-        try:
-            perf = bench_config(cfg_name, overrides, steps=steps, warmup=3)
-            value = perf["samples_per_sec_per_chip"]
-            base = ASSUMED_BASELINE[metric]
-            print(
-                json.dumps(
-                    {
-                        "metric": metric,
-                        "value": round(value, 2),
-                        "unit": "samples/sec/chip",
-                        "vs_baseline": round(value / base, 4),
-                    }
-                )
-            )
-            return 0
-        except Exception as e:  # fall down the ladder, report at the end
-            last_err = e
+    if "--child" in sys.argv:
+        return child_main(sys.argv[sys.argv.index("--child") + 1])
+
+    _progress(
+        f"start platform={os.environ.get('JAX_PLATFORMS', 'default')} "
+        f"probe_timeout={PROBE_TIMEOUT_S}s candidate_timeout={CANDIDATE_TIMEOUT_S}s"
+    )
+    kind, probe_err = probe_backend()
+    if probe_err is not None:
+        print(json.dumps({"metric": "error", "value": 0, "unit": "",
+                          "vs_baseline": 0, "error": probe_err}), flush=True)
+        return 1
+
+    last_err: str = "no candidates ran"
+    for metric, cfg_name, overrides, steps in CANDIDATES:
+        spec = json.dumps({"metric": metric, "config": cfg_name,
+                           "overrides": overrides, "steps": steps})
+        _progress(f"candidate {cfg_name} ({metric}) ...")
+        t0 = time.perf_counter()
+        rc, out, err = _run_bounded(
+            [sys.executable, os.path.abspath(__file__), "--child", spec],
+            CANDIDATE_TIMEOUT_S,
+        )
+        dt = time.perf_counter() - t0
+        if rc is None:
+            last_err = f"{cfg_name}: timeout after {CANDIDATE_TIMEOUT_S}s"
+            _progress(last_err)
             continue
-    print(json.dumps({"metric": "error", "value": 0, "unit": "", "vs_baseline": 0,
-                      "error": str(last_err)}))
+        result = None
+        for line in out.splitlines():
+            if line.startswith("RESULT "):
+                result = json.loads(line[len("RESULT "):])
+        if rc == 0 and result is not None:
+            _progress(f"candidate {cfg_name} done in {dt:.1f}s")
+            print(json.dumps(result), flush=True)
+            return 0
+        last_err = f"{cfg_name}: rc={rc}: {err.strip()[-300:]}"
+        _progress(last_err)
+    print(json.dumps({"metric": "error", "value": 0, "unit": "",
+                      "vs_baseline": 0, "error": last_err[:500]}), flush=True)
     return 1
 
 

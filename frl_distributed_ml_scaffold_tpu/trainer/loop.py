@@ -167,18 +167,34 @@ class Trainer:
         # Batch shardings are inferred from the example batch structure.
         example = example_input(cfg.data, cfg.model, batch_size=self.env.batch_axis_size)
         batch_sh = self._batch_shardings(example)
-        self.train_step = self._mesh_scoped(
-            jax.jit(
-                step_fn,
-                in_shardings=(self.state_shardings, batch_sh),
-                out_shardings=(self.state_shardings, None),
-                donate_argnums=(0,),
-            )
+        self._train_step_jit = jax.jit(
+            step_fn,
+            in_shardings=(self.state_shardings, batch_sh),
+            out_shardings=(self.state_shardings, None),
+            donate_argnums=(0,),
         )
+        self.train_step = self._mesh_scoped(self._train_step_jit)
         eval_fn = make_eval_step(self.loss_fn, self.policy, seed=cfg.trainer.seed)
         self.eval_step = self._mesh_scoped(
             jax.jit(eval_fn, in_shardings=(self.state_shardings, batch_sh))
         )
+
+    def step_cost_analysis(self, state, batch) -> dict | None:
+        """XLA cost analysis of ONE compiled train step (flops/bytes), or
+        None if the backend doesn't support it. Used by bench.py to report
+        model FLOPs and MFU (BASELINE.md protocol)."""
+        try:
+            lowered = self._mesh_scoped(self._train_step_jit.lower)(state, batch)
+            # Pre-optimization analysis: no backend compile (the jit call
+            # path would not reuse an AOT executable, so compiling here
+            # would double the heaviest compile), and theoretical model
+            # FLOPs — the MFU convention — rather than post-fusion counts.
+            cost = lowered.cost_analysis()
+            if isinstance(cost, (list, tuple)):  # older jax returns [dict]
+                cost = cost[0] if cost else None
+            return dict(cost) if cost else None
+        except Exception:
+            return None
 
     # ----------------------------------------------------------------- loop
 
