@@ -11,7 +11,9 @@ contract is exact (gather) and distributionally where it involves RNG.
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
+import platform
 import subprocess
 import threading
 
@@ -21,7 +23,31 @@ from frl_distributed_ml_scaffold_tpu.utils.logging import get_logger
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
 _SRC = os.path.join(_NATIVE_DIR, "frl_data.cpp")
-_LIB = os.path.join(_NATIVE_DIR, "libfrl_data.so")
+
+
+def _host_arch_tag() -> str:
+    """Host/microarch tag for the cached .so filename.
+
+    The library is built with ``-march=native`` and cached next to the
+    source; on a shared filesystem a multi-host launch could otherwise load
+    a lib built for a different CPU and die with SIGILL. Tag = machine arch
+    + a hash of the CPU feature flags, so each distinct microarchitecture
+    builds (and loads) its own copy.
+    """
+    flags = ""
+    try:
+        with open("/proc/cpuinfo") as fh:
+            for line in fh:
+                if line.lower().startswith(("flags", "features")):
+                    flags = line
+                    break
+    except OSError:
+        pass
+    h = hashlib.sha256(flags.encode()).hexdigest()[:8]
+    return f"{platform.machine()}-{h}"
+
+
+_LIB = os.path.join(_NATIVE_DIR, f"libfrl_data.{_host_arch_tag()}.so")
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
@@ -109,6 +135,14 @@ def gather_rows(src: np.ndarray, idx: np.ndarray) -> np.ndarray:
     [0, 1] in the same pass. Other dtypes take the numpy fallback.
     """
     idx = np.ascontiguousarray(idx, dtype=np.int64)
+    # Validated here so both code paths fail identically: the native kernel
+    # would memcpy out of bounds where numpy raises (or, worse, silently
+    # wraps negatives) — reject both, before either path runs.
+    if idx.size and (idx.min() < 0 or idx.max() >= len(src)):
+        bad = idx[(idx < 0) | (idx >= len(src))][0]
+        raise IndexError(
+            f"gather_rows index {bad} out of bounds for {len(src)} rows"
+        )
     lib = _load()
     u8 = src.dtype == np.uint8
     if lib is None or not src.flags["C_CONTIGUOUS"] or (
@@ -164,21 +198,54 @@ def augment_batch(
     return out
 
 
+_M64 = (1 << 64) - 1
+
+
+def _splitmix64(s: int) -> tuple[int, int]:
+    """One splitmix64 step — bit-identical to the C++ kernel's RNG."""
+    s = (s + 0x9E3779B97F4A7C15) & _M64
+    z = s
+    z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & _M64
+    z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & _M64
+    return s, (z ^ (z >> 31)) & _M64
+
+
+def _uniform01(s: int) -> tuple[int, np.float32]:
+    s, z = _splitmix64(s)
+    return s, np.float32(z >> 40) * np.float32(1.0 / 16777216.0)
+
+
 def _augment_numpy(x, crop, *, seed, train, mean, std):
+    """Numpy fallback with the SAME splitmix64 draws as the C++ kernel.
+
+    Identical RNG streams matter: batches are pure functions of
+    (seed, step) per the resume contract, so resuming in an environment
+    whose native availability differs must not change the training stream.
+    The parity test asserts native == numpy bit-for-bit.
+    """
     n, h, w, c = x.shape
-    rng = np.random.default_rng(seed)
     out = np.empty((n, crop, crop, c), np.float32)
+    max_y, max_x = h - crop, w - crop
     for i in range(n):
         if train:
-            y0 = rng.integers(0, h - crop + 1) if h > crop else 0
-            x0 = rng.integers(0, w - crop + 1) if w > crop else 0
+            # Same per-sample stream derivation and draw order as C++
+            # (draws skipped when the crop has no freedom, as there).
+            s = (seed ^ ((0x243F6A8885A308D3 * (i + 1)) & _M64)) & _M64
+            y0 = x0 = 0
+            if max_y > 0:
+                s, u = _uniform01(s)
+                y0 = min(int(np.float32(u * np.float32(max_y + 1))), max_y)
+            if max_x > 0:
+                s, u = _uniform01(s)
+                x0 = min(int(np.float32(u * np.float32(max_x + 1))), max_x)
+            s, u = _uniform01(s)
             patch = x[i, y0:y0 + crop, x0:x0 + crop]
-            if rng.random() < 0.5:
+            if u < np.float32(0.5):
                 patch = patch[:, ::-1]
         else:
-            y0, x0 = (h - crop) // 2, (w - crop) // 2
+            y0, x0 = max_y // 2, max_x // 2
             patch = x[i, y0:y0 + crop, x0:x0 + crop]
-        out[i] = (patch - mean) / std
+        out[i] = (np.asarray(patch, np.float32) - mean) / std
     return out
 
 

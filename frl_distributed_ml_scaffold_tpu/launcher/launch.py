@@ -58,11 +58,19 @@ def _parse_args(argv=None):
     return p.parse_args(argv)
 
 
+def hlo_dump_flags(dump_dir: str) -> str:
+    """XLA_FLAGS value for optimized-HLO dumps (SURVEY C19).
+
+    Lives here (jax-free module), NOT in utils.profiling: that module
+    imports jax at top level, which would freeze JAX_PLATFORMS before
+    ``_configure_platform``'s CPU forcing below could run.
+    """
+    return f"--xla_dump_to={dump_dir} --xla_dump_hlo_as_text"
+
+
 def _configure_platform(args) -> None:
     """Must run before jax initializes a backend."""
     if args.hlo_dump:
-        from frl_distributed_ml_scaffold_tpu.utils.profiling import hlo_dump_flags
-
         os.environ["XLA_FLAGS"] = (
             os.environ.get("XLA_FLAGS", "") + " " + hlo_dump_flags(args.hlo_dump)
         ).strip()

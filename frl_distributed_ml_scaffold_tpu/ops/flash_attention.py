@@ -339,6 +339,15 @@ def flash_attention(
     )
     from frl_distributed_ml_scaffold_tpu.ops.ring_attention import dense_attention
 
+    # Config validation first, before any backend/tileability fallback, so
+    # an invalid config raises identically on CPU simulation and real TPU.
+    env = current_mesh_env()
+    if env is not None and env.axis_size("seq") > 1:
+        raise ValueError(
+            "attention='flash' does not shard the sequence axis; use "
+            "attention='ring' (or 'ulysses') when mesh.seq > 1"
+        )
+
     t, d = q.shape[1], q.shape[3]
     bq = _pick_block(t, min(block_q, t))
     bk = _pick_block(t, min(block_k, t))
@@ -369,7 +378,6 @@ def flash_attention(
         o = _flash(qT, kT, vT, causal, bq, bk, interpret)
         return o.transpose(0, 2, 1, 3)
 
-    env = current_mesh_env()
     if env is None:
         return _call(q, k, v)
     # Under a mesh, GSPMD cannot partition an opaque pallas_call — an
@@ -377,12 +385,7 @@ def flash_attention(
     # attention is independent per (batch, head), so shard_map over the
     # batch axes and the TP head axis keeps it fully local (same mechanism
     # as the ring/Ulysses siblings). Sequence sharding is ring attention's
-    # job, not this kernel's.
-    if env.axis_size("seq") > 1:
-        raise ValueError(
-            "attention='flash' does not shard the sequence axis; use "
-            "attention='ring' (or 'ulysses') when mesh.seq > 1"
-        )
+    # job, not this kernel's (validated above).
     spec = jax.sharding.PartitionSpec(BATCH_AXES, None, "model", None)
     return jax.shard_map(
         _call,

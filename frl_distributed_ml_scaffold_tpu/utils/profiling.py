@@ -11,10 +11,10 @@ The reference's torch.profiler+NVTX tier maps to three TPU-native tools:
 - **Host-loop annotations**: ``annotate("load_batch")`` wraps host-side
   phases in ``jax.profiler.TraceAnnotation`` so loader stalls are visible
   between device steps in the same trace.
-- **HLO dumps**: ``hlo_dump_flags(dir)`` returns the ``XLA_FLAGS`` string
-  that makes XLA write optimized HLO per compilation — compile-time
-  inspection (fusion decisions, layout choices). Must be in the environment
-  before the backend initializes; the launcher threads it through.
+- **HLO dumps**: ``launcher.launch.hlo_dump_flags(dir)`` (jax-free module —
+  must be set in the environment before the backend initializes) makes XLA
+  write optimized HLO per compilation for compile-time inspection (fusion
+  decisions, layout choices).
 
 Process-0 gating matches the logging tier: traces are only captured on the
 primary process (each host profiles its own devices; one trace is what the
@@ -80,11 +80,6 @@ def annotate(name: str) -> Iterator[None]:
     """Host-loop phase annotation visible in the profiler timeline."""
     with jax.profiler.TraceAnnotation(name):
         yield
-
-
-def hlo_dump_flags(dump_dir: str) -> str:
-    """XLA_FLAGS value for optimized-HLO dumps (set before backend init)."""
-    return f"--xla_dump_to={dump_dir} --xla_dump_hlo_as_text"
 
 
 def annotate_step(step: int):

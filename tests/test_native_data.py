@@ -81,6 +81,33 @@ def test_augment_deterministic_in_seed():
     assert not np.array_equal(a, c)
 
 
+def test_gather_rows_rejects_out_of_bounds():
+    src = np.random.default_rng(0).random((16, 4), np.float32)
+    for bad in ([-1, 0], [0, 16], [99]):
+        with pytest.raises(IndexError, match="out of bounds"):
+            nv.gather_rows(src, np.array(bad, np.int64))
+
+
+@requires_native
+def test_augment_native_matches_numpy_bitwise():
+    """Native and numpy augmentation must share ONE RNG stream: resuming in
+    an environment whose native availability differs must not change the
+    training stream (batches are pure functions of (seed, step))."""
+    rng = np.random.default_rng(5)
+    # (40, 36): both crop dims free; (32, 36) / (40, 32) / (32, 32): the
+    # draw-SKIPPING branches (a dim with no crop freedom consumes no RNG
+    # draw, in C++ and numpy alike — the subtlest part of the contract).
+    for h, w in ((40, 36), (32, 36), (40, 32), (32, 32)):
+        x = rng.random((16, h, w, 3), np.float32)
+        for seed, train in ((0, True), (123456789, True), (7, False)):
+            a = nv.augment_batch(x, 32, seed=seed, train=train)
+            b = nv._augment_numpy(
+                x, 32, seed=seed, train=train,
+                mean=nv._IMAGENET_MEAN, std=nv._IMAGENET_STD,
+            )
+            np.testing.assert_array_equal(a, b)
+
+
 def test_augment_rejects_oversized_crop():
     x = np.zeros((2, 16, 16, 3), np.float32)
     with pytest.raises(ValueError, match="crop"):

@@ -7,10 +7,10 @@ import os
 
 from frl_distributed_ml_scaffold_tpu.config import apply_overrides, get_config
 from frl_distributed_ml_scaffold_tpu.trainer.loop import Trainer
+from frl_distributed_ml_scaffold_tpu.launcher.launch import hlo_dump_flags
 from frl_distributed_ml_scaffold_tpu.utils.profiling import (
     WindowProfiler,
     annotate,
-    hlo_dump_flags,
 )
 
 
