@@ -298,7 +298,111 @@ def _bwd(causal, block_q, block_k, interpret, residuals, dout):
     return dq, dk, dv
 
 
-# ------------------------------------------------------------------ public
+# ----------------------------------------------------- block-level (ring)
+#
+# Mid-level API used by ring attention (ops/ring_attention.py): attention of
+# a local Q block against ONE K/V block, exposing the per-row logsumexp so
+# the caller can merge blocks (ring hops) exactly. The pallas kernels above
+# already have precisely these semantics — ``_fwd`` returns (o, lse) and
+# ``_bwd`` consumes the *global* lse (p = exp(s - lse) yields the exact
+# probabilities for any sub-block once lse covers the full row) — so the
+# ring's per-hop compute is the same fused kernel as single-device flash.
+# Dense fallbacks (identical numerics, with lse) cover untileable shapes and
+# non-TPU backends.
+
+
+def _dense_fwd_lse(q, k, v, *, causal):
+    """(B, H, Tq, D) x (B, H, Tk, D) -> (o, lse[B, H, Tq, 1]); fp32 softmax,
+    bf16-multiply/fp32-accumulate matmuls — the kernel's numerics contract."""
+    d = q.shape[-1]
+    scale = 1.0 / np.sqrt(d)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        tq, tk = s.shape[-2], s.shape[-1]
+        qpos = lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
+        kpos = lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
+        s = jnp.where((qpos >= kpos)[None, None], s, _NEG_INF)
+    m = s.max(axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.maximum(p.sum(axis=-1, keepdims=True), 1e-30)
+    acc = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return (acc / l).astype(q.dtype), m + jnp.log(l)
+
+
+def _dense_bwd_lse(q, k, v, o, lse, do, *, causal):
+    """Dense mirror of the pallas backward: exact p from the global lse."""
+    d = q.shape[-1]
+    scale = 1.0 / np.sqrt(d)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        tq, tk = s.shape[-2], s.shape[-1]
+        qpos = lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
+        kpos = lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
+        s = jnp.where((qpos >= kpos)[None, None], s, _NEG_INF)
+    p = jnp.exp(s - lse)  # masked entries: exp(-inf - lse) == 0
+    do32 = do.astype(jnp.float32)
+    delta = (do32 * o.astype(jnp.float32)).sum(axis=-1, keepdims=True)
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, do32)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", do32, v.astype(jnp.float32))
+    ds = p * (dp - delta) * scale
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds.astype(k.dtype), k,
+                    preferred_element_type=jnp.float32)
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, q.astype(jnp.float32))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def _block_tileable(q, k) -> tuple[int, int] | None:
+    tq, tk, d = q.shape[2], k.shape[2], q.shape[3]
+    if tq != tk or d % 32 != 0:
+        return None
+    bq, bk = _pick_block(tq, min(256, tq)), _pick_block(tk, min(256, tk))
+    return (bq, bk) if bq and bk else None
+
+
+def _block_route(q, k, interpret):
+    """(blocks, interpret) — blocks=None means take the dense path."""
+    blocks = _block_tileable(q, k)
+    if interpret is None:
+        # Pallas interpreter mode is far slower than the identical-numerics
+        # dense math — off-TPU it is opt-in (tests force interpret=True).
+        if _interpret_default():
+            return None, None
+        interpret = False
+    return blocks, interpret
+
+
+def block_attention_fwd(q, k, v, *, causal, interpret=None):
+    """One-block attention in kernel layout (B, H, T, D) -> (o, lse).
+
+    ``causal`` here means Q and K share a position origin (the ring's
+    diagonal hop); off-diagonal hops pass ``causal=False``. Routes to the
+    pallas kernel when the shapes tile (and the backend is TPU or
+    ``interpret`` is forced), else to the identical-numerics dense path.
+    """
+    blocks, interpret = _block_route(q, k, interpret)
+    if blocks is None:
+        return _dense_fwd_lse(q, k, v, causal=causal)
+    bq, bk = blocks
+    return _fwd(q, k, v, causal=causal, block_q=bq, block_k=bk,
+                interpret=interpret)
+
+
+def block_attention_bwd(q, k, v, o, lse, do, *, causal, interpret=None):
+    """Per-block gradients given the GLOBAL per-row lse -> (dq, dk, dv).
+
+    Because ``p = exp(s - lse)`` with the row's full-sequence lse gives the
+    exact attention probabilities restricted to this block, summing these
+    per-block grads over all visible blocks reproduces the full-attention
+    gradient — the identity the ring backward is built on.
+    """
+    blocks, interpret = _block_route(q, k, interpret)
+    if blocks is None:
+        return _dense_bwd_lse(q, k, v, o, lse, do, causal=causal)
+    bq, bk = blocks
+    return _bwd(causal, bq, bk, interpret, (q, k, v, o, lse), do)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -339,13 +443,17 @@ def flash_attention(
     )
     from frl_distributed_ml_scaffold_tpu.ops.ring_attention import dense_attention
 
-    # Config validation first, before any backend/tileability fallback, so
-    # an invalid config raises identically on CPU simulation and real TPU.
+    # Seq-axis routing first, before any backend/tileability fallback, so
+    # the behavior is identical on CPU simulation and real TPU: a flash call
+    # under a sequence-sharded mesh delegates to ring attention, whose
+    # per-hop compute is this very kernel (block_attention_fwd/_bwd below) —
+    # flash + SP compose rather than conflict.
+    from frl_distributed_ml_scaffold_tpu.ops.ring_attention import ring_attention
+
     env = current_mesh_env()
     if env is not None and env.axis_size("seq") > 1:
-        raise ValueError(
-            "attention='flash' does not shard the sequence axis; use "
-            "attention='ring' (or 'ulysses') when mesh.seq > 1"
+        return ring_attention(
+            q, k, v, axis_name="seq", causal=causal, interpret=interpret
         )
 
     t, d = q.shape[1], q.shape[3]

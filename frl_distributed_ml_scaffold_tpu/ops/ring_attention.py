@@ -3,18 +3,27 @@
 SURVEY C8 / §5. The sequence dimension is sharded across the ``seq`` mesh
 axis; each shard keeps its queries resident while the K/V shards rotate
 around the ring via ``ppermute`` (one neighbor hop per step — this is what
-rides the ICI torus links). Softmax is computed online (flash-attention
-style running max/denominator rescaling), so no shard ever materializes the
-full [T, T] score matrix — memory stays O(T_local²·heads) and context
-length scales linearly with the ring size.
+rides the ICI torus links). Each hop's compute is the fused Pallas flash
+kernel (ops/flash_attention.py) on TPU — per-hop VMEM stays O(block·D) and
+no shard ever materializes even its local [T_local, T_local] score matrix —
+so context length is bounded by HBM across the ring, not by any quadratic
+buffer. Off-TPU the hops use the identical-numerics dense-with-lse path.
 
-Numerics: logits/accumulators in fp32, output cast back to the input dtype;
-fully-masked blocks contribute nothing (mask applied to probabilities, not
-only logits, so the -1e30 sentinel can't leak through the running max).
+Hop results merge exactly by per-row logsumexp: each hop returns its block
+output normalized by its own (o, lse); ``logaddexp`` combines them into the
+running global (o, lse). Hops strictly above the causal diagonal skip their
+compute entirely (``lax.cond`` — only the ppermute runs).
+
+Backward is a custom VJP (the memory win would otherwise be lost to saved
+per-hop K/V residuals): only the LOCAL (q, k, v, o, lse) are saved; the
+backward re-rotates K/V around the ring together with traveling dK/dV
+accumulators, each hop calling the flash backward kernels with the global
+lse (``p = exp(s - lse)`` is exact per block). Accumulators travel in fp32.
 """
 
 from __future__ import annotations
 
+import functools
 from functools import partial
 
 import jax
@@ -35,19 +44,24 @@ def ring_attention(
     *,
     axis_name: str = "seq",
     causal: bool = True,
+    interpret: bool | None = None,
 ) -> jax.Array:
     """(B, T, H, D) attention with T sharded over ``axis_name``.
 
     Called from model code tracing under the GSPMD jit; wraps its own
     shard_map region over the current mesh. Falls back to single-device
-    blockwise math when the seq axis is trivial.
+    blockwise math when the seq axis is trivial. ``interpret`` forces the
+    per-hop Pallas kernels into interpreter mode (tests on CPU); ``None``
+    picks pallas-on-TPU / dense-elsewhere automatically.
     """
     env = current_mesh_env()
     if env is None or env.axis_size(axis_name) == 1:
         return dense_attention(q, k, v, causal=causal)
 
     spec = P(BATCH_AXES, axis_name, "model", None)
-    inner = partial(_ring_shard_fn, axis_name=axis_name, causal=causal)
+    inner = partial(
+        _ring_shard_fn, axis_name=axis_name, causal=causal, interpret=interpret
+    )
     return jax.shard_map(
         inner,
         mesh=env.mesh,
@@ -57,52 +71,124 @@ def ring_attention(
     )(q, k, v)
 
 
-def _ring_shard_fn(q, k, v, *, axis_name: str, causal: bool):
+def _ring_shard_fn(q, k, v, *, axis_name: str, causal: bool, interpret):
+    # Flash kernels run in (B, H, T, D); these transposes sit against the
+    # projection reshapes outside and fuse in XLA.
+    qT, kT, vT = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+    o = _ring(qT, kT, vT, axis_name, causal, interpret)
+    return o.transpose(0, 2, 1, 3)
+
+
+def _merge(o_run, lse_run, o_blk, lse_blk):
+    """Exact combine of two self-normalized partial attentions (fp32)."""
+    lse_new = jnp.logaddexp(lse_run, lse_blk)
+    w_run = jnp.exp(lse_run - lse_new)
+    w_blk = jnp.exp(lse_blk - lse_new)
+    o_new = o_run * w_run + o_blk.astype(jnp.float32) * w_blk
+    return o_new, lse_new
+
+
+def _ring_fwd_loop(q, k, v, axis_name, causal, interpret):
+    from frl_distributed_ml_scaffold_tpu.ops.flash_attention import (
+        block_attention_fwd,
+    )
+
     idx = lax.axis_index(axis_name)
     n = lax.axis_size(axis_name)
-    b, t_local, h, d = q.shape
-    scale = 1.0 / np.sqrt(d)
-
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    def body(s, carry):
-        k_blk, v_blk, m, l, acc = carry
-        # After s rotations this shard holds the block originally at idx - s.
-        src = (idx - s) % n
-        # bf16 operands, fp32 accumulation: the MXU's native mode (same
-        # contract as dense_attention).
-        logits = (
-            jnp.einsum(
-                "bqhd,bkhd->bhqk", q, k_blk, preferred_element_type=jnp.float32
-            )
-            * scale
-        )
+    # Hop 0: the diagonal block (q and k share a position origin).
+    o0, lse = block_attention_fwd(q, k, v, causal=causal, interpret=interpret)
+    o = o0.astype(jnp.float32)
+    k_blk, v_blk = k, v
+    for s in range(1, n):
+        k_blk, v_blk = lax.ppermute((k_blk, v_blk), axis_name, perm)
         if causal:
-            qpos = idx * t_local + jnp.arange(t_local)[:, None]
-            kpos = src * t_local + jnp.arange(t_local)[None, :]
-            mask = (qpos >= kpos)[None, None]
+            # After s rotations this shard holds the block from idx - s.
+            src = (idx - s) % n
+            o_s, lse_s = lax.cond(
+                src < idx,  # blocks from the future contribute nothing
+                lambda a, b, c: block_attention_fwd(
+                    a, b, c, causal=False, interpret=interpret
+                ),
+                lambda a, b, c: (
+                    jnp.zeros_like(o0),
+                    jnp.full_like(lse, _NEG_INF),
+                ),
+                q,
+                k_blk,
+                v_blk,
+            )
         else:
-            mask = jnp.ones((1, 1, t_local, t_local), bool)
-        logits = jnp.where(mask, logits, _NEG_INF)
-        m_new = jnp.maximum(m, logits.max(axis=-1))
-        p = jnp.exp(logits - m_new[..., None]) * mask  # mask kills sentinels
-        alpha = jnp.exp(m - m_new)
-        l_new = l * alpha + p.sum(axis=-1)
-        acc_new = acc * alpha.transpose(0, 2, 1)[..., None] + jnp.einsum(
-            "bhqk,bkhd->bqhd",
-            p.astype(q.dtype),
-            v_blk,
-            preferred_element_type=jnp.float32,
-        )
-        k_nxt, v_nxt = lax.ppermute((k_blk, v_blk), axis_name, perm)
-        return (k_nxt, v_nxt, m_new, l_new, acc_new)
+            o_s, lse_s = block_attention_fwd(
+                q, k_blk, v_blk, causal=False, interpret=interpret
+            )
+        o, lse = _merge(o, lse, o_s, lse_s)
+    return o.astype(q.dtype), lse
 
-    m0 = jnp.full((b, h, t_local), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, t_local), jnp.float32)
-    acc0 = jnp.zeros((b, t_local, h, d), jnp.float32)
-    _, _, _, l, acc = lax.fori_loop(0, n, body, (k, v, m0, l0, acc0))
-    denom = jnp.maximum(l, 1e-20).transpose(0, 2, 1)[..., None]
-    return (acc / denom).astype(q.dtype)
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring(q, k, v, axis_name, causal, interpret):
+    o, _ = _ring_fwd_loop(q, k, v, axis_name, causal, interpret)
+    return o
+
+
+def _ring_fwd_rule(q, k, v, axis_name, causal, interpret):
+    o, lse = _ring_fwd_loop(q, k, v, axis_name, causal, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _ring_bwd_rule(axis_name, causal, interpret, res, do):
+    from frl_distributed_ml_scaffold_tpu.ops.flash_attention import (
+        block_attention_bwd,
+    )
+
+    q, k, v, o, lse = res
+    idx = lax.axis_index(axis_name)
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    # Hop 0: diagonal. dK/dV accumulators then TRAVEL with their block
+    # around the ring (fp32), so each visiting device adds its contribution
+    # in place; after the final rotation they arrive back home complete.
+    dq0, dk0, dv0 = block_attention_bwd(
+        q, k, v, o, lse, do, causal=causal, interpret=interpret
+    )
+    dq = dq0.astype(jnp.float32)
+    dk_acc = dk0.astype(jnp.float32)
+    dv_acc = dv0.astype(jnp.float32)
+    k_blk, v_blk = k, v
+    for s in range(1, n):
+        k_blk, v_blk, dk_acc, dv_acc = lax.ppermute(
+            (k_blk, v_blk, dk_acc, dv_acc), axis_name, perm
+        )
+        src = (idx - s) % n
+
+        def _live(args):
+            q_, k_, v_, o_, lse_, do_ = args
+            return block_attention_bwd(
+                q_, k_, v_, o_, lse_, do_, causal=False, interpret=interpret
+            )
+
+        def _dead(args):
+            q_, k_, v_, _o, _l, _d = args
+            return jnp.zeros_like(q_), jnp.zeros_like(k_), jnp.zeros_like(v_)
+
+        if causal:
+            dq_s, dk_s, dv_s = lax.cond(
+                src < idx, _live, _dead, (q, k_blk, v_blk, o, lse, do)
+            )
+        else:
+            dq_s, dk_s, dv_s = _live((q, k_blk, v_blk, o, lse, do))
+        dq = dq + dq_s.astype(jnp.float32)
+        dk_acc = dk_acc + dk_s.astype(jnp.float32)
+        dv_acc = dv_acc + dv_s.astype(jnp.float32)
+    # n-1 rotations have happened; one more brings each block's dK/dV home.
+    dk_acc, dv_acc = lax.ppermute((dk_acc, dv_acc), axis_name, perm)
+    return dq.astype(q.dtype), dk_acc.astype(k.dtype), dv_acc.astype(v.dtype)
+
+
+_ring.defvjp(_ring_fwd_rule, _ring_bwd_rule)
 
 
 def dense_attention(q, k, v, *, causal: bool = True):

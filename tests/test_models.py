@@ -177,6 +177,83 @@ def test_ring_attention_grads_match_dense():
         )
 
 
+def test_ring_attention_pallas_hops_match_dense():
+    """Ring with the per-hop Pallas flash kernels (interpreter mode on CPU):
+    the fused path must match dense exactly like the fallback path does.
+    Shapes chosen so each hop tiles (T_local=16 ≥ min block 8, d%32==0)."""
+    from frl_distributed_ml_scaffold_tpu.ops.ring_attention import (
+        _single_shard_attention,
+        ring_attention,
+    )
+
+    env = build_mesh(MeshConfig(data=2, seq=4))
+    set_current_mesh(env)
+    q, k, v = _rand_qkv(jax.random.key(5), b=2, t=64, h=2, d=32)
+    ref = _single_shard_attention(q, k, v, causal=True)
+    out = jax.jit(
+        lambda q, k, v: ring_attention(q, k, v, interpret=True)
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ring_attention_pallas_hops_grads_match_dense():
+    """Custom-VJP ring backward with the Pallas per-hop backward kernels:
+    traveling dK/dV accumulators + global-lse probabilities must reproduce
+    the dense gradients."""
+    from frl_distributed_ml_scaffold_tpu.ops.ring_attention import (
+        _single_shard_attention,
+        ring_attention,
+    )
+
+    env = build_mesh(MeshConfig(data=2, seq=4))
+    set_current_mesh(env)
+    q, k, v = _rand_qkv(jax.random.key(7), b=2, t=64, h=2, d=32)
+
+    def loss(att):
+        def f(q, k, v):
+            o = att(q, k, v)
+            return (o * jnp.cos(jnp.arange(o.size).reshape(o.shape))).sum()
+
+        return jax.jit(jax.grad(f, argnums=(0, 1, 2)))
+
+    g_ring = loss(
+        lambda q, k, v: ring_attention(q, k, v, interpret=True)
+    )(q, k, v)
+    g_dense = loss(
+        lambda q, k, v: _single_shard_attention(q, k, v, causal=True)
+    )(q, k, v)
+    for gr, gd, name in zip(g_ring, g_dense, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gr), np.asarray(gd), atol=5e-5,
+            err_msg=f"pallas ring grad mismatch for d{name}",
+        )
+
+
+def test_ring_attention_long_context_32k():
+    """SURVEY §5 long-context: 32k tokens over an 8-shard ring runs without
+    materializing any [T, T] buffer — per-shard transient memory is the
+    4k-local block only (the round-1 implementation would have needed
+    8 × [4k, 4k] fp32 per head here). Forward-only, bf16, sanity-checked
+    against row-stochasticity (output of attention over bf16-normal V has
+    bounded magnitude)."""
+    from frl_distributed_ml_scaffold_tpu.ops.ring_attention import ring_attention
+
+    env = build_mesh(MeshConfig(data=1, seq=8))
+    set_current_mesh(env)
+    t = 32768
+    kq, kk, kv = jax.random.split(jax.random.key(11), 3)
+    q = jax.random.normal(kq, (1, t, 1, 32), jnp.bfloat16)
+    k = jax.random.normal(kk, (1, t, 1, 32), jnp.bfloat16)
+    v = jax.random.normal(kv, (1, t, 1, 32), jnp.bfloat16)
+    out = jax.jit(lambda q, k, v: ring_attention(q, k, v))(q, k, v)
+    out = np.asarray(out, np.float32)
+    assert out.shape == (1, t, 1, 32)
+    assert np.isfinite(out).all()
+    # Attention outputs are convex combinations of V rows — magnitudes stay
+    # O(1); a softmax/merge bug (double-normalization, lse drift) blows this.
+    assert np.abs(out).max() < 6.0
+
+
 def test_ring_attention_noncausal():
     from frl_distributed_ml_scaffold_tpu.ops.ring_attention import (
         _single_shard_attention,
