@@ -168,14 +168,10 @@ class GPT(nn.Module):
         x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
 
         if cfg.pipeline_stages > 1:
-            if cfg.attention in ("ring", "ulysses", "flash"):
-                # Those ops open their own shard_map regions (flash: to keep
-                # the Pallas call per-device under GSPMD), which cannot nest
-                # inside the pipeline's vmapped stage body.
-                raise ValueError(
-                    f"attention={cfg.attention!r} does not compose with "
-                    "pipeline_stages > 1; use dense attention"
-                )
+            # flash/ring/ulysses open their own shard_map regions; the
+            # pipeline's stage vmap names its axis (spmd_axis_name="pipe"),
+            # so those regions batch over the stage dim and compose — no
+            # mode exclusions.
             from frl_distributed_ml_scaffold_tpu.parallel.pipeline import (
                 SpmdPipeline,
             )

@@ -89,6 +89,8 @@ def _merge(o_run, lse_run, o_blk, lse_blk):
 
 
 def _ring_fwd_loop(q, k, v, axis_name, causal, interpret):
+    """``lax.fori_loop`` over hops 1..n-1 (hop 0, the diagonal, is special)
+    so traced program size stays O(1) in the ring size."""
     from frl_distributed_ml_scaffold_tpu.ops.flash_attention import (
         block_attention_fwd,
     )
@@ -98,10 +100,10 @@ def _ring_fwd_loop(q, k, v, axis_name, causal, interpret):
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     # Hop 0: the diagonal block (q and k share a position origin).
-    o0, lse = block_attention_fwd(q, k, v, causal=causal, interpret=interpret)
-    o = o0.astype(jnp.float32)
-    k_blk, v_blk = k, v
-    for s in range(1, n):
+    o0, lse0 = block_attention_fwd(q, k, v, causal=causal, interpret=interpret)
+
+    def body(s, carry):
+        k_blk, v_blk, o, lse = carry
         k_blk, v_blk = lax.ppermute((k_blk, v_blk), axis_name, perm)
         if causal:
             # After s rotations this shard holds the block from idx - s.
@@ -113,7 +115,7 @@ def _ring_fwd_loop(q, k, v, axis_name, causal, interpret):
                 ),
                 lambda a, b, c: (
                     jnp.zeros_like(o0),
-                    jnp.full_like(lse, _NEG_INF),
+                    jnp.full_like(lse0, _NEG_INF),
                 ),
                 q,
                 k_blk,
@@ -124,6 +126,11 @@ def _ring_fwd_loop(q, k, v, axis_name, causal, interpret):
                 q, k_blk, v_blk, causal=False, interpret=interpret
             )
         o, lse = _merge(o, lse, o_s, lse_s)
+        return (k_blk, v_blk, o, lse)
+
+    _, _, o, lse = lax.fori_loop(
+        1, n, body, (k, v, o0.astype(jnp.float32), lse0)
+    )
     return o.astype(q.dtype), lse
 
 
@@ -154,35 +161,44 @@ def _ring_bwd_rule(axis_name, causal, interpret, res, do):
     dq0, dk0, dv0 = block_attention_bwd(
         q, k, v, o, lse, do, causal=causal, interpret=interpret
     )
-    dq = dq0.astype(jnp.float32)
-    dk_acc = dk0.astype(jnp.float32)
-    dv_acc = dv0.astype(jnp.float32)
-    k_blk, v_blk = k, v
-    for s in range(1, n):
+
+    def _live(args):
+        q_, k_, v_, o_, lse_, do_ = args
+        return block_attention_bwd(
+            q_, k_, v_, o_, lse_, do_, causal=False, interpret=interpret
+        )
+
+    def _dead(args):
+        q_, k_, v_, _o, _l, _d = args
+        return jnp.zeros_like(q_), jnp.zeros_like(k_), jnp.zeros_like(v_)
+
+    def body(s, carry):
+        k_blk, v_blk, dq, dk_acc, dv_acc = carry
         k_blk, v_blk, dk_acc, dv_acc = lax.ppermute(
             (k_blk, v_blk, dk_acc, dv_acc), axis_name, perm
         )
-        src = (idx - s) % n
-
-        def _live(args):
-            q_, k_, v_, o_, lse_, do_ = args
-            return block_attention_bwd(
-                q_, k_, v_, o_, lse_, do_, causal=False, interpret=interpret
-            )
-
-        def _dead(args):
-            q_, k_, v_, _o, _l, _d = args
-            return jnp.zeros_like(q_), jnp.zeros_like(k_), jnp.zeros_like(v_)
-
         if causal:
+            src = (idx - s) % n
             dq_s, dk_s, dv_s = lax.cond(
                 src < idx, _live, _dead, (q, k_blk, v_blk, o, lse, do)
             )
         else:
             dq_s, dk_s, dv_s = _live((q, k_blk, v_blk, o, lse, do))
-        dq = dq + dq_s.astype(jnp.float32)
-        dk_acc = dk_acc + dk_s.astype(jnp.float32)
-        dv_acc = dv_acc + dv_s.astype(jnp.float32)
+        return (
+            k_blk,
+            v_blk,
+            dq + dq_s.astype(jnp.float32),
+            dk_acc + dk_s.astype(jnp.float32),
+            dv_acc + dv_s.astype(jnp.float32),
+        )
+
+    _, _, dq, dk_acc, dv_acc = lax.fori_loop(
+        1,
+        n,
+        body,
+        (k, v, dq0.astype(jnp.float32), dk0.astype(jnp.float32),
+         dv0.astype(jnp.float32)),
+    )
     # n-1 rotations have happened; one more brings each block's dK/dV home.
     dk_acc, dv_acc = lax.ppermute((dk_acc, dv_acc), axis_name, perm)
     return dq.astype(q.dtype), dk_acc.astype(k.dtype), dv_acc.astype(v.dtype)

@@ -124,6 +124,7 @@ def opt_state_specs(
         zip(param_names, spec_leaves, param_shapes), key=lambda t: -len(t[0])
     )
     fsdp_size = mesh.shape["fsdp"]
+    unmatched: list[str] = []
 
     def decide(name: str, leaf) -> P:
         if not hasattr(leaf, "shape") or leaf.shape == ():
@@ -134,6 +135,14 @@ def opt_state_specs(
                 matched = pspec
                 break
         if matched is None:
+            # Suffix matching relies on optax states embedding param-shaped
+            # subtrees under param-suffixed paths; optimizers that don't
+            # (factored states, custom wrappers) land here and stay
+            # replicated. Silent replication is a ZeRO no-op — record any
+            # leaf big enough that sharding it would have mattered (only
+            # when the mesh could have sharded it at all: fsdp > 1).
+            if fsdp_size > 1 and int(np.prod(leaf.shape)) >= parallel.fsdp_min_size:
+                unmatched.append(name)
             return P()
         if parallel.opt_sharding == "zero1":
             # ZeRO-1: shard the state mirror over fsdp even though params
@@ -149,7 +158,19 @@ def opt_state_specs(
             return matched
         raise ValueError(f"unknown opt_sharding {parallel.opt_sharding!r}")
 
-    return named_tree_map(decide, opt_state_shapes)
+    specs = named_tree_map(decide, opt_state_shapes)
+    if unmatched:
+        from frl_distributed_ml_scaffold_tpu.utils.logging import get_logger
+
+        get_logger().warning(
+            "opt_state_specs: %d optimizer-state leaves >= fsdp_min_size "
+            "did not suffix-match any parameter and stay REPLICATED "
+            "(opt_sharding=%r is a no-op for them): %s",
+            len(unmatched),
+            parallel.opt_sharding,
+            ", ".join(unmatched[:5]) + (", ..." if len(unmatched) > 5 else ""),
+        )
+    return specs
 
 
 def shardings_from_specs(specs: Any, mesh: Mesh) -> Any:

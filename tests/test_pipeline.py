@@ -90,11 +90,109 @@ def test_pp_moe_aux_loss_batch_invariant():
     assert 0.5 < ratio < 2.0, f"aux scales with microbatch count: {ratio}"
 
 
-def test_pp_rejects_shard_map_attention():
-    cfg = GPTConfig(**TINY, pipeline_stages=2, attention="ring")
-    tokens = np.zeros((4, 16), np.int32)
-    with pytest.raises(ValueError, match="does not compose"):
-        GPT(cfg, FP32).init({"params": jax.random.key(0)}, tokens, train=False)
+def test_pp_composes_with_ring_attention():
+    """Round-1 exclusion, lifted: ring attention's shard_map (ppermute over
+    ``seq``) nests inside the pipeline's stage vmap via spmd_axis_name.
+    PP=2 x SP=2 forward must match the plain dense-attention model."""
+    from frl_distributed_ml_scaffold_tpu.config.schema import MeshConfig
+    from frl_distributed_ml_scaffold_tpu.dist.mesh import build_mesh, mesh_context
+
+    base = GPTConfig(**TINY)
+    pp_ring = dataclasses.replace(
+        base, pipeline_stages=2, pipeline_microbatches=2, attention="ring"
+    )
+    tokens = jax.random.randint(jax.random.key(4), (4, 16), 0, 128)
+    m_plain, m_pp = GPT(base, FP32), GPT(pp_ring, FP32)
+    params = m_plain.init({"params": jax.random.key(0)}, tokens, train=False)["params"]
+    out_plain = m_plain.apply({"params": params}, tokens, train=False)
+
+    env = build_mesh(MeshConfig(pipe=2, data=2, seq=2))
+    with mesh_context(env):
+        out_pp = jax.jit(
+            lambda p, t: m_pp.apply({"params": p}, t, train=False)
+        )(plain_to_pipelined(params, 2), tokens)
+    np.testing.assert_allclose(out_plain, out_pp, atol=2e-5, rtol=1e-5)
+
+
+def test_pp_composes_with_ring_attention_grads(tmp_path):
+    """The same composition must hold through the backward (custom-VJP ring
+    inside the vmapped/scanned pipeline): train a PP=2 x SP=2 x DP=2 GPT
+    end-to-end and check the loss moves."""
+    trainer = make_gpt_trainer(
+        tmp_path,
+        [
+            "model.pipeline_stages=2",
+            "model.pipeline_microbatches=2",
+            "model.attention=ring",
+            "mesh.pipe=2",
+            "mesh.data=2",
+            "mesh.seq=2",
+        ],
+    )
+    state = trainer.init_state()
+    _, metrics = run_steps(trainer, state, steps=4)
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_pp_composes_with_ulysses_attention():
+    """Ulysses' all_to_all shard_map also batches over the stage vmap."""
+    from frl_distributed_ml_scaffold_tpu.config.schema import MeshConfig
+    from frl_distributed_ml_scaffold_tpu.dist.mesh import build_mesh, mesh_context
+
+    base = GPTConfig(**TINY)
+    pp_uly = dataclasses.replace(
+        base, pipeline_stages=2, pipeline_microbatches=2, attention="ulysses"
+    )
+    tokens = jax.random.randint(jax.random.key(5), (4, 16), 0, 128)
+    m_plain, m_pp = GPT(base, FP32), GPT(pp_uly, FP32)
+    params = m_plain.init({"params": jax.random.key(0)}, tokens, train=False)["params"]
+    out_plain = m_plain.apply({"params": params}, tokens, train=False)
+
+    env = build_mesh(MeshConfig(pipe=2, data=2, seq=2))
+    with mesh_context(env):
+        out_pp = jax.jit(
+            lambda p, t: m_pp.apply({"params": p}, t, train=False)
+        )(plain_to_pipelined(params, 2), tokens)
+    np.testing.assert_allclose(out_plain, out_pp, atol=2e-5, rtol=1e-5)
+
+
+def test_pp_composes_with_flash_attention_pallas(monkeypatch):
+    """flash's pallas_call-in-shard_map also nests under the stage vmap.
+    On CPU flash normally falls back to dense before reaching its shard_map,
+    so force interpreter mode through the model's call site to exercise the
+    real composition the TPU path uses."""
+    import functools
+
+    from frl_distributed_ml_scaffold_tpu.config.schema import MeshConfig
+    from frl_distributed_ml_scaffold_tpu.dist.mesh import build_mesh, mesh_context
+    import importlib
+
+    # The ops package re-exports the flash_attention FUNCTION under the same
+    # name, shadowing the submodule on attribute import.
+    fa_mod = importlib.import_module(
+        "frl_distributed_ml_scaffold_tpu.ops.flash_attention"
+    )
+
+    monkeypatch.setattr(
+        fa_mod,
+        "flash_attention",
+        functools.partial(fa_mod.flash_attention, interpret=True),
+    )
+    base = GPTConfig(**TINY)
+    pp_flash = dataclasses.replace(
+        base, pipeline_stages=2, pipeline_microbatches=2, attention="flash"
+    )
+    tokens = jax.random.randint(jax.random.key(6), (4, 16), 0, 128)
+    m_plain, m_pp = GPT(base, FP32), GPT(pp_flash, FP32)
+    params = m_plain.init({"params": jax.random.key(0)}, tokens, train=False)["params"]
+    out_plain = m_plain.apply({"params": params}, tokens, train=False)
+
+    env = build_mesh(MeshConfig(pipe=2, data=2, model=2))
+    with mesh_context(env):
+        out_pp = jax.jit(
+            lambda p, t: m_pp.apply({"params": p}, t, train=False)
+        )(plain_to_pipelined(params, 2), tokens)
+    np.testing.assert_allclose(out_plain, out_pp, atol=2e-5, rtol=1e-5)
 
 
 GPT_TINY_OVERRIDES = [
