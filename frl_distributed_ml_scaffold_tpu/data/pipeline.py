@@ -32,7 +32,11 @@ def _build_source(cfg: DataConfig, split: str):
         from frl_distributed_ml_scaffold_tpu.data.imagenet import ImageNet
 
         return ImageNet(cfg, split=split)
-    if name in ("lm_synthetic", "lm"):
+    if name == "lm":
+        from frl_distributed_ml_scaffold_tpu.data.lm import TokenBinLM
+
+        return TokenBinLM(cfg, split=split)
+    if name == "lm_synthetic":
         from frl_distributed_ml_scaffold_tpu.data.synthetic import SyntheticLM
 
         return SyntheticLM(cfg, split=split)

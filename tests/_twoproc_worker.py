@@ -1,0 +1,75 @@
+"""Worker half of the 2-process jax.distributed integration test.
+
+Launched (twice) by tests/test_multiprocess.py with FRL_TPU_* rendezvous env
+vars. Exercises the real multi-process branches that single-process CI can
+never reach: ``jax.distributed.initialize``, ``process_count() > 1`` host
+collectives, per-process data sharding, and two global train steps.
+Prints ``CHECK <json>`` lines the parent asserts on.
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> int:
+    os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=4")
+    import jax
+
+    # The axon sitecustomize pins jax_platforms at the config level, which
+    # beats env vars — force CPU the same way tests/conftest.py does.
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from frl_distributed_ml_scaffold_tpu.dist import collectives
+    from frl_distributed_ml_scaffold_tpu.dist.initialize import (
+        initialize_distributed,
+        process_count,
+        process_index,
+        shutdown_distributed,
+    )
+
+    initialize_distributed()  # resolves from FRL_TPU_* env vars
+    pid = process_index()
+    out = {"process_count": process_count(), "pid": pid}
+    out["local_devices"] = jax.local_device_count()
+    out["global_devices"] = jax.device_count()
+
+    # Host-tier collectives (SURVEY C2): the branches with process_count>1.
+    got = collectives.host_broadcast(np.array([41.0 + pid], np.float32))
+    out["broadcast"] = float(got[0])  # both must see process 0's 41.0
+    gathered = collectives.host_all_gather(np.array([pid], np.int32))
+    out["all_gather"] = np.asarray(gathered).ravel().tolist()
+    collectives.barrier("twoproc-test")
+
+    # Global-batch assembly + two real train steps over a 2-process mesh.
+    from frl_distributed_ml_scaffold_tpu.config import apply_overrides, get_config
+    from frl_distributed_ml_scaffold_tpu.trainer.loop import Trainer
+
+    cfg = apply_overrides(
+        get_config("mnist_mlp"),
+        [
+            "data.global_batch_size=16",
+            "data.prefetch=0",
+            "model.hidden_sizes=32",
+            "trainer.log_every=1000",
+            "checkpoint.enabled=false",
+            "workdir=" + os.environ["FRL_TEST_WORKDIR"],
+        ],
+    )
+    trainer = Trainer(cfg)
+    out["local_batch"] = trainer.pipeline.local_batch_size
+    state = trainer.init_state()
+    for step in range(2):
+        batch = trainer.pipeline.global_batch(step)
+        state, metrics = trainer.train_step(state, batch)
+    # The loss is a global reduction — every process must report the same.
+    out["loss"] = round(float(jax.device_get(metrics["loss"])), 6)
+    print("CHECK " + json.dumps(out), flush=True)
+    shutdown_distributed()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

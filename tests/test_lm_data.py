@@ -1,0 +1,121 @@
+"""Token-bin LM corpus loader (SURVEY C16): producer/consumer round-trip,
+deterministic step-indexed sampling, synthetic fallback, trainer wiring."""
+
+import json
+
+import numpy as np
+import pytest
+
+from frl_distributed_ml_scaffold_tpu.config.schema import DataConfig
+from frl_distributed_ml_scaffold_tpu.data.lm import TokenBinLM, write_token_bin
+
+
+def make_corpus(tmp_path, n=4096, vocab=512, seed=0):
+    rng = np.random.default_rng(seed)
+    tokens = rng.integers(0, vocab, size=n)
+    write_token_bin(str(tmp_path / "train.bin"), tokens, vocab_size=vocab)
+    return tokens
+
+
+def test_round_trip_windows_match_source(tmp_path):
+    tokens = make_corpus(tmp_path)
+    cfg = DataConfig(
+        name="lm", data_dir=str(tmp_path), seq_len=64, vocab_size=512
+    )
+    src = TokenBinLM(cfg, split="train")
+    assert not src.is_synthetic
+    batch = src.batch(3, batch_size=8)
+    assert batch["tokens"].shape == (8, 65)  # seq_len + 1 (shifted target)
+    assert batch["tokens"].dtype == np.int32
+    # Every row must be a contiguous window of the source stream.
+    for row in batch["tokens"]:
+        starts = np.where(tokens == row[0])[0]
+        assert any(
+            np.array_equal(tokens[s : s + 65], row)
+            for s in starts
+            if s + 65 <= len(tokens)
+        )
+
+
+def test_sampling_is_pure_function_of_step(tmp_path):
+    make_corpus(tmp_path)
+    cfg = DataConfig(
+        name="lm", data_dir=str(tmp_path), seq_len=32, vocab_size=512
+    )
+    a = TokenBinLM(cfg, split="train").batch(5, 4)
+    b = TokenBinLM(cfg, split="train").batch(5, 4)  # fresh instance
+    np.testing.assert_array_equal(a["tokens"], b["tokens"])
+    c = TokenBinLM(cfg, split="train").batch(6, 4)
+    assert not np.array_equal(a["tokens"], c["tokens"])
+    # Validation split reuses train.bin but salts the stream.
+    d = TokenBinLM(cfg, split="validation").batch(5, 4)
+    assert not np.array_equal(a["tokens"], d["tokens"])
+
+
+def test_uint16_dtype_chosen_and_read_back(tmp_path):
+    make_corpus(tmp_path, vocab=500)
+    with open(tmp_path / "train.bin.json") as fh:
+        assert json.load(fh)["dtype"] == "uint16"
+    big = np.array([0, 70000, 3], dtype=np.int64)
+    write_token_bin(str(tmp_path / "big" / "train.bin"), big)
+    cfg = DataConfig(
+        name="lm", data_dir=str(tmp_path / "big"), seq_len=1, vocab_size=100000
+    )
+    src = TokenBinLM(cfg, split="train")
+    assert src._mm.dtype == np.uint32
+    assert 70000 in np.asarray(src.batch(0, 4)["tokens"])
+
+
+def test_synthetic_fallback_without_dir():
+    cfg = DataConfig(name="lm", data_dir=None, seq_len=16, vocab_size=64)
+    src = TokenBinLM(cfg, split="train")
+    assert src.is_synthetic
+    assert src.batch(0, 4)["tokens"].shape == (4, 17)
+
+
+def test_vocab_mismatch_raises(tmp_path):
+    make_corpus(tmp_path, vocab=512)
+    cfg = DataConfig(
+        name="lm", data_dir=str(tmp_path), seq_len=16, vocab_size=256
+    )
+    with pytest.raises(ValueError, match="vocab_size"):
+        TokenBinLM(cfg, split="train")
+
+
+def test_gpt_trains_on_token_bin_corpus(tmp_path):
+    """BASELINE config 4 accepts data.name=lm + data_dir (VERDICT r1 #6)."""
+    from frl_distributed_ml_scaffold_tpu.config import apply_overrides, get_config
+    from frl_distributed_ml_scaffold_tpu.trainer.loop import Trainer
+
+    corpus_dir = tmp_path / "corpus"
+    corpus_dir.mkdir()
+    make_corpus(corpus_dir, n=8192, vocab=256)
+    cfg = apply_overrides(
+        get_config("gpt2_medium_zero1"),
+        [
+            "model.num_layers=2",
+            "model.hidden_dim=64",
+            "model.num_heads=2",
+            "model.vocab_size=256",
+            "model.seq_len=32",
+            "data.name=lm",
+            f"data.data_dir={corpus_dir}",
+            "data.seq_len=32",
+            "data.vocab_size=256",
+            "data.global_batch_size=8",
+            "data.prefetch=0",
+            "trainer.grad_accum=1",
+            "trainer.log_every=1000",
+            "checkpoint.enabled=false",
+            f"workdir={tmp_path}",
+        ],
+    )
+    trainer = Trainer(cfg)
+    assert not trainer.pipeline.source.is_synthetic
+    state = trainer.init_state()
+    losses = []
+    for step in range(4):
+        batch = trainer.pipeline.global_batch(step)
+        state, metrics = trainer.train_step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert np.isfinite(losses).all()

@@ -1,0 +1,72 @@
+"""2-process ``jax.distributed`` integration (SURVEY §4 simulated-distributed
+tier, call stack (a)): the ``num_processes > 1`` branches of initialize/
+collectives/data-sharding actually execute — on CPU, via a real TCP
+rendezvous between two subprocesses (VERDICT r1 #5)."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_init_collectives_and_train(tmp_path):
+    port = _free_port()
+    workers = []
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env_base = {
+        **os.environ,
+        "FRL_TPU_COORDINATOR": f"127.0.0.1:{port}",
+        "FRL_TPU_NUM_PROCESSES": "2",
+        "FRL_TEST_WORKDIR": str(tmp_path),
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        # Script-by-path puts tests/ on sys.path, not the repo root; keep any
+        # existing entries (the axon sitecustomize lives on PYTHONPATH).
+        "PYTHONPATH": repo_root
+        + (os.pathsep + os.environ["PYTHONPATH"] if os.environ.get("PYTHONPATH") else ""),
+    }
+    script = os.path.join(os.path.dirname(__file__), "_twoproc_worker.py")
+    for pid in range(2):
+        env = {**env_base, "FRL_TPU_PROCESS_ID": str(pid)}
+        workers.append(
+            subprocess.Popen(
+                [sys.executable, script],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+                cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            )
+        )
+    outputs = []
+    for w in workers:
+        out, _ = w.communicate(timeout=280)
+        outputs.append(out)
+    for w, out in zip(workers, outputs):
+        assert w.returncode == 0, f"worker failed:\n{out[-3000:]}"
+
+    checks = []
+    for out in outputs:
+        lines = [l for l in out.splitlines() if l.startswith("CHECK ")]
+        assert lines, f"no CHECK line in worker output:\n{out[-3000:]}"
+        checks.append(json.loads(lines[-1][6:]))
+
+    by_pid = {c["pid"]: c for c in checks}
+    assert set(by_pid) == {0, 1}
+    for c in checks:
+        assert c["process_count"] == 2
+        assert c["local_devices"] == 4
+        assert c["global_devices"] == 8
+        assert c["broadcast"] == 41.0  # process 0's value, on both
+        assert c["all_gather"] == [0, 1]
+        assert c["local_batch"] == 8  # 16 global over 2 processes
+    # The global loss reduction must agree across processes exactly.
+    assert by_pid[0]["loss"] == by_pid[1]["loss"]
