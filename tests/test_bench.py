@@ -30,6 +30,25 @@ def test_bench_config_emits_protocol_record():
     assert rec["per_chip_batch_size"] * rec["n_chips"] == 64
 
 
+def test_protocol_record_reports_mfu_when_peak_known(monkeypatch):
+    """On chips with a known bf16 peak the record must carry model FLOPs +
+    MFU (BASELINE.md protocol). CPU has no honest peak, so inject one —
+    this exercises the same path the TPU jaxpr-fallback count feeds."""
+    import jax
+
+    kind = getattr(jax.devices()[0], "device_kind", "cpu")
+    monkeypatch.setitem(bench.CHIP_PEAK_FLOPS, kind, 1e12)
+    perf = bench.bench_config(
+        "mnist_mlp",
+        ["data.global_batch_size=64", "trainer.log_every=1000000"],
+        steps=4,
+        warmup=1,
+    )
+    rec = perf["_record"]
+    assert rec.get("model_flops_per_sample", 0) > 0
+    assert 0 < rec["mfu"] < 1.0
+
+
 def test_run_all_writes_jsonl(tmp_path, monkeypatch):
     monkeypatch.setattr(
         bench, "ALL_CONFIGS",
