@@ -10,12 +10,13 @@ If ``data_dir`` points at a directory of pre-decoded ``.npy`` shards
 
 from __future__ import annotations
 
-import glob
-import os
-
 import numpy as np
 
 from frl_distributed_ml_scaffold_tpu.config.schema import DataConfig
+from frl_distributed_ml_scaffold_tpu.data.shards import (
+    ShardedNpyCorpus,
+    warn_missing,
+)
 from frl_distributed_ml_scaffold_tpu.data.synthetic import SyntheticImages
 
 
@@ -23,20 +24,23 @@ class ImageNet:
     def __init__(self, cfg: DataConfig, *, split: str):
         self.cfg = cfg
         self._fallback = None
-        self._shards = None
+        self._corpus = None
         self._train = split == "train"
         if cfg.data_dir:
-            xs = sorted(glob.glob(os.path.join(cfg.data_dir, f"{split}_images_*.npy")))
-            ys = sorted(glob.glob(os.path.join(cfg.data_dir, f"{split}_labels_*.npy")))
-            if xs and ys:
-                # Keep per-shard mmaps — concatenating would materialize the
-                # whole dataset (hundreds of GB for ImageNet) in host RAM.
-                self._shards = [np.load(p, mmap_mode="r") for p in xs]
-                self._y = np.concatenate([np.load(p) for p in ys]).astype(np.int32)
-                self._offsets = np.cumsum([0] + [len(s) for s in self._shards])
-                self._n = int(self._offsets[-1])
+            corpus = ShardedNpyCorpus(cfg.data_dir, split, "images")
+            if corpus.found:
+                shape = corpus.item_shape
+                if min(shape[0], shape[1]) < cfg.image_size:
+                    raise ValueError(
+                        f"stored shards are {shape[0]}x{shape[1]} but "
+                        f"data.image_size={cfg.image_size}; shards must be "
+                        "stored at >= the model input size"
+                    )
+                self._corpus = corpus
                 self._seed = cfg.shuffle_seed
-        if self._shards is None:
+            else:
+                warn_missing(cfg.data_dir, "images", split)
+        if self._corpus is None:
             self._fallback = SyntheticImages(cfg, split=split)
 
     @property
@@ -49,24 +53,9 @@ class ImageNet:
         from frl_distributed_ml_scaffold_tpu.data import native
 
         rng = np.random.default_rng((self._seed, step, host_offset))
-        idx = np.sort(rng.integers(0, self._n, size=batch_size))
-        shard_ids = np.searchsorted(self._offsets, idx, side="right") - 1
-        # Per-shard native gather: the parallel memcpy is where the mmap
-        # page faults happen (SURVEY §7 hard part 5).
-        shape = self._shards[0].shape[1:]
+        idx = np.sort(rng.integers(0, self._corpus.n, size=batch_size))
         size = self.cfg.image_size
-        if min(shape[0], shape[1]) < size:
-            raise ValueError(
-                f"stored shards are {shape[0]}x{shape[1]} but "
-                f"data.image_size={size}; shards must be stored at >= the "
-                "model input size"
-            )
-        x = np.empty((batch_size,) + shape, np.float32)
-        for s in np.unique(shard_ids):
-            mask = shard_ids == s
-            x[mask] = native.gather_rows(
-                self._shards[s], idx[mask] - self._offsets[s]
-            )
+        x, labels = self._corpus.gather(idx)
         # Always through the augment kernel: normalize + (train) flip apply
         # even when stored size == input size — storage size must never
         # change training statistics. Larger storage adds the random crop.
@@ -76,4 +65,4 @@ class ImageNet:
             seed=hash((self._seed, step, host_offset)) & (2**63 - 1),
             train=self._train,
         )
-        return {"image": x, "label": self._y[idx]}
+        return {"image": x, "label": labels}

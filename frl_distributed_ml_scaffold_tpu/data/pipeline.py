@@ -40,7 +40,11 @@ def _build_source(cfg: DataConfig, split: str):
         from frl_distributed_ml_scaffold_tpu.data.synthetic import SyntheticLM
 
         return SyntheticLM(cfg, split=split)
-    if name in ("video_synthetic", "video"):
+    if name == "video":
+        from frl_distributed_ml_scaffold_tpu.data.video import VideoClips
+
+        return VideoClips(cfg, split=split)
+    if name == "video_synthetic":
         from frl_distributed_ml_scaffold_tpu.data.synthetic import SyntheticVideo
 
         return SyntheticVideo(cfg, split=split)

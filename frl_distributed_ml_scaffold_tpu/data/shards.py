@@ -1,0 +1,91 @@
+"""Shared sharded-.npy corpus machinery (ImageNet images, video clips).
+
+One implementation of shard discovery, data/label pairing validation,
+memmapping, offset bookkeeping, and the native per-shard gather — so the
+per-dataset loaders hold only their format specifics (augmentation, shape
+contracts). Validation happens at construction: a missing labels shard or a
+shape-divergent data shard fails here with a clear error, never mid-run.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+
+import numpy as np
+
+
+class ShardedNpyCorpus:
+    """``{split}_{kind}_XXX.npy`` data shards + ``{split}_labels_XXX.npy``.
+
+    ``found`` is False when ``data_dir`` holds no complete shard set (the
+    caller decides how to fall back); any *inconsistent* shard set raises.
+    """
+
+    def __init__(self, data_dir: str, split: str, kind: str):
+        self.found = False
+        xs = sorted(glob.glob(os.path.join(data_dir, f"{split}_{kind}_*.npy")))
+        ys = sorted(glob.glob(os.path.join(data_dir, f"{split}_labels_*.npy")))
+        if not xs and not ys:
+            return
+        def _idx(paths, tag):
+            out = []
+            for p in paths:
+                m = re.search(rf"{tag}_(\d+)\.npy$", os.path.basename(p))
+                out.append(m.group(1) if m else os.path.basename(p))
+            return out
+
+        if _idx(xs, kind) != _idx(ys, "labels"):
+            # A partially-copied corpus must not silently misalign labels.
+            raise ValueError(
+                f"{data_dir}: {kind}/labels shards do not pair up — "
+                f"{[os.path.basename(p) for p in xs]} vs "
+                f"{[os.path.basename(p) for p in ys]}"
+            )
+        # Memmap per shard — real corpora dwarf host RAM.
+        self.shards = [np.load(p, mmap_mode="r") for p in xs]
+        shapes = {s.shape[1:] for s in self.shards}
+        if len(shapes) != 1:
+            raise ValueError(
+                f"{data_dir}: inconsistent {kind} shard shapes {shapes}; "
+                "re-shard the corpus"
+            )
+        self.item_shape = self.shards[0].shape[1:]
+        self.y = np.concatenate([np.load(p) for p in ys]).astype(np.int32)
+        self.offsets = np.cumsum([0] + [len(s) for s in self.shards])
+        self.n = int(self.offsets[-1])
+        if len(self.y) != self.n:
+            raise ValueError(
+                f"{data_dir}: {self.n} {kind} items but {len(self.y)} labels"
+            )
+        self.found = True
+
+    def gather(self, idx: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """(items, labels) for sorted indices, via the native parallel
+        gather (the memmap page faults happen inside the C++ kernel)."""
+        from frl_distributed_ml_scaffold_tpu.data import native
+
+        shard_ids = np.searchsorted(self.offsets, idx, side="right") - 1
+        x = np.empty((len(idx),) + self.item_shape, np.float32)
+        for s in np.unique(shard_ids):
+            mask = shard_ids == s
+            x[mask] = native.gather_rows(
+                self.shards[s], idx[mask] - self.offsets[s]
+            )
+        return x, self.y[idx]
+
+
+def warn_missing(data_dir: str, what: str, split: str) -> None:
+    """A configured-but-absent corpus must be loud: training silently on
+    synthetic data is the classic wasted-run trap."""
+    from frl_distributed_ml_scaffold_tpu.utils.logging import get_logger
+
+    get_logger().warning(
+        "%s: data_dir=%s has no %s shards for split %r — falling back to "
+        "SYNTHETIC data; fix data.data_dir if a real corpus was intended",
+        what,
+        data_dir,
+        what,
+        split,
+    )

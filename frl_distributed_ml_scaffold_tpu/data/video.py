@@ -1,0 +1,96 @@
+"""Video-clip loader: pre-decoded .npy clip shards if present, else synthetic.
+
+SURVEY C16 names "Ego4D clip loaders". Raw video containers need a decode
+stack (ffmpeg/decord) this zero-egress image doesn't ship — and decoding
+per-step would starve the chip anyway (SURVEY §7 hard part 5). The TPU-
+idiomatic pipeline decodes OFFLINE into fixed-shape clip tensors, exactly
+as the ImageNet path stores pre-decoded frames: ``{split}_clips_XXX.npy``
+``(N, T, H, W, C) float32`` + ``{split}_labels_XXX.npy`` ``(N,) int``,
+memmapped per shard, gathered per batch with the native C++ kernel.
+``write_clip_shards`` below is the producer side (and documents the format
+for any external decoder script).
+
+Sampling is step-indexed like every loader here: batch = f(seed, step), so
+resume is exact and host count is irrelevant to the stream.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from frl_distributed_ml_scaffold_tpu.config.schema import DataConfig
+from frl_distributed_ml_scaffold_tpu.data.shards import (
+    ShardedNpyCorpus,
+    warn_missing,
+)
+from frl_distributed_ml_scaffold_tpu.data.synthetic import SyntheticVideo
+
+
+def write_clip_shards(
+    out_dir: str,
+    clips: np.ndarray,
+    labels: np.ndarray,
+    *,
+    split: str = "train",
+    shard_size: int = 256,
+) -> int:
+    """Write ``(N, T, H, W, C)`` clips + ``(N,)`` labels as memmappable
+    shards. Returns the shard count. Float32 clips are stored as-is;
+    normalize offline (or here) once, not per step."""
+    clips = np.asarray(clips, np.float32)
+    labels = np.asarray(labels, np.int32)
+    if clips.ndim != 5 or len(clips) != len(labels):
+        raise ValueError(
+            f"clips must be (N,T,H,W,C) with matching labels; got "
+            f"{clips.shape} / {labels.shape}"
+        )
+    os.makedirs(out_dir, exist_ok=True)
+    n_shards = 0
+    for i in range(0, len(clips), shard_size):
+        np.save(
+            os.path.join(out_dir, f"{split}_clips_{n_shards:03d}.npy"),
+            clips[i : i + shard_size],
+        )
+        np.save(
+            os.path.join(out_dir, f"{split}_labels_{n_shards:03d}.npy"),
+            labels[i : i + shard_size],
+        )
+        n_shards += 1
+    return n_shards
+
+
+class VideoClips:
+    def __init__(self, cfg: DataConfig, *, split: str):
+        self.cfg = cfg
+        self._fallback = None
+        self._corpus = None
+        if cfg.data_dir:
+            corpus = ShardedNpyCorpus(cfg.data_dir, split, "clips")
+            if corpus.found:
+                want = (cfg.num_frames, cfg.image_size, cfg.image_size, cfg.channels)
+                if corpus.item_shape != want:
+                    raise ValueError(
+                        f"stored clips are {corpus.item_shape} but the config "
+                        f"wants {want}; re-shard or fix data.num_frames/"
+                        "image_size"
+                    )
+                self._corpus = corpus
+            else:
+                warn_missing(cfg.data_dir, "clips", split)
+        if self._corpus is None:
+            self._fallback = SyntheticVideo(cfg, split=split)
+        self._seed = cfg.shuffle_seed + (0 if split == "train" else 7919)
+
+    @property
+    def is_synthetic(self) -> bool:
+        return self._fallback is not None
+
+    def batch(self, step: int, batch_size: int, host_offset: int = 0) -> dict:
+        if self._fallback is not None:
+            return self._fallback.batch(step, batch_size, host_offset)
+        rng = np.random.default_rng((self._seed, step, host_offset))
+        idx = np.sort(rng.integers(0, self._corpus.n, size=batch_size))
+        x, y = self._corpus.gather(idx)
+        return {"video": x, "label": y}

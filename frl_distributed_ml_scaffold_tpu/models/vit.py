@@ -13,9 +13,40 @@ from typing import Any
 
 import flax.linen as nn
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 from frl_distributed_ml_scaffold_tpu.config.schema import ViTConfig
+from frl_distributed_ml_scaffold_tpu.parallel.partition import PartitionRules
 from frl_distributed_ml_scaffold_tpu.precision import Policy
+
+
+def vit_tp_rules() -> PartitionRules:
+    """Megatron column/row sharding for the ViT encoder (SURVEY C6) — also
+    used by the video classifier, which reuses ``EncoderBlock``.
+
+    flax ``MultiHeadDotProductAttention`` kernels are (dim, heads, head_dim)
+    for q/k/v and (heads, head_dim, dim) for out: sharding the HEADS dim
+    over ``model`` is the column/row split — per-head attention stays local
+    and GSPMD inserts one allreduce after out, one after the MLP down-proj.
+    The FSDP overlay (parallel.param_sharding=fsdp) then picks the largest
+    still-unsharded dim, so TP x FSDP composes without special cases.
+    """
+    return PartitionRules(
+        rules=(
+            (
+                r"MultiHeadDotProductAttention_\d+/(query|key|value)/kernel",
+                P(None, "model", None),
+            ),
+            (
+                r"MultiHeadDotProductAttention_\d+/(query|key|value)/bias",
+                P("model", None),
+            ),
+            (r"MultiHeadDotProductAttention_\d+/out/kernel", P("model", None, None)),
+            (r"MlpBlock_\d+/Dense_0/kernel", P(None, "model")),
+            (r"MlpBlock_\d+/Dense_0/bias", P("model")),
+            (r"MlpBlock_\d+/Dense_1/kernel", P("model", None)),
+        )
+    )
 
 
 class MlpBlock(nn.Module):

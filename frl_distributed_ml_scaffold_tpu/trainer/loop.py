@@ -60,6 +60,17 @@ def model_partition_rules(model_cfg: Any, env: MeshEnv) -> PartitionRules | None
         from frl_distributed_ml_scaffold_tpu.models.gpt import gpt_tp_rules
 
         return gpt_tp_rules(pipelined=pipelined)
+    if family in ("vit", "video"):
+        from frl_distributed_ml_scaffold_tpu.models.vit import vit_tp_rules
+
+        return vit_tp_rules()
+    if env.axis_size("model") > 1:
+        # ResNet has no TP rules by design (conv channel counts don't split
+        # Megatron-style); a model>1 mesh would silently replicate — refuse.
+        raise ValueError(
+            f"model family {family!r} has no tensor-parallel partition "
+            "rules; mesh.model must be 1"
+        )
     return None
 
 

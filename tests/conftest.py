@@ -27,3 +27,14 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent compilation cache (repo-local, gitignored): the suite's wall
+# time is dominated by XLA compiles of the same tiny models on the same
+# 8-device mesh; caching them across runs cuts repeat `pytest` runs by
+# minutes on this 1-core box. Fresh checkouts just pay the one-time fill.
+_cache_dir = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"
+)
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)

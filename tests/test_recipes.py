@@ -176,6 +176,114 @@ def test_tp_actually_shards_params(tmp_path):
     assert "model" in tuple(qk.sharding.spec), qk.sharding.spec
 
 
+VIT_TINY = [
+    "model.image_size=32",
+    "model.patch_size=8",
+    "model.hidden_dim=64",
+    "model.num_layers=2",
+    "model.num_heads=4",
+    "model.num_classes=8",
+    "data.image_size=32",
+    "data.num_classes=8",
+    "data.global_batch_size=16",
+    "optimizer.warmup_steps=0",
+    "trainer.log_every=1000",
+    "precision.policy=fp32",
+    "checkpoint.enabled=false",
+]
+
+
+def run_vit(tmp_path, mesh_overrides, steps=3):
+    cfg = apply_overrides(
+        get_config("imagenet_vitb_fsdp"),
+        VIT_TINY + [f"workdir={tmp_path}"] + mesh_overrides,
+    )
+    trainer = Trainer(cfg)
+    state = trainer.init_state()
+    for step in range(steps):
+        batch = trainer.pipeline.global_batch(step)
+        state, metrics = trainer.train_step(state, batch)
+    return jax.device_get(state), metrics, trainer
+
+
+def test_vit_tp_matches_dp(tmp_path):
+    """TP rules for the ViT encoder (VERDICT r1 #7): TP=2 == pure DP, and
+    TP composes with the recipe's FSDP overlay."""
+    ref_state, _, _ = run_vit(
+        tmp_path / "dp", ["mesh.data=8", "parallel.param_sharding=replicated"]
+    )
+    tp_state, _, _ = run_vit(
+        tmp_path / "tp",
+        ["mesh.data=4", "mesh.model=2", "parallel.param_sharding=replicated"],
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=5e-4, rtol=1e-4),
+        ref_state.params,
+        tp_state.params,
+    )
+
+
+def test_vit_tp_actually_shards_params_with_fsdp(tmp_path):
+    _, _, trainer = run_vit(
+        tmp_path,
+        ["mesh.data=2", "mesh.model=2", "mesh.fsdp=2",
+         "parallel.fsdp_min_size=64"],
+        steps=1,
+    )
+    state = trainer.init_state()
+    attn = state.params["EncoderBlock_0"]["MultiHeadDotProductAttention_0"]
+    q_spec = tuple(attn["query"]["kernel"].sharding.spec)
+    assert "model" in q_spec, q_spec
+    assert "fsdp" in q_spec, q_spec  # TP x FSDP overlay both live
+    out_spec = tuple(attn["out"]["kernel"].sharding.spec)
+    assert out_spec and out_spec[0] == "model", out_spec  # row-split
+
+
+def test_video_tp_runs_and_shards(tmp_path):
+    cfg = apply_overrides(
+        get_config("ego4d_video_elastic"),
+        [
+            "model.image_size=32",
+            "model.num_frames=4",
+            "model.tubelet_size=2,8,8",
+            "model.hidden_dim=64",
+            "model.num_layers=2",
+            "model.num_heads=4",
+            "model.num_classes=8",
+            "data.image_size=32",
+            "data.num_frames=4",
+            "data.num_classes=8",
+            "data.global_batch_size=8",
+            "precision.policy=fp32",
+            "trainer.log_every=1000",
+            "checkpoint.enabled=false",
+            "mesh.data=4",
+            "mesh.model=2",
+            f"workdir={tmp_path}",
+        ],
+    )
+    trainer = Trainer(cfg)
+    state = trainer.init_state()
+    blk = state.params["EncoderBlock_0"]["MlpBlock_0"]
+    assert "model" in tuple(blk["Dense_0"]["kernel"].sharding.spec)
+    for step in range(2):
+        batch = trainer.pipeline.global_batch(step)
+        state, metrics = trainer.train_step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_resnet_refuses_model_axis(tmp_path):
+    """ResNet has no TP rules; a model>1 mesh must refuse loudly instead of
+    silently replicating (VERDICT r1 missing #6)."""
+    cfg = apply_overrides(
+        get_config("imagenet_rn50_ddp"),
+        ["model.depth=18", "data.image_size=32", "mesh.data=4",
+         "mesh.model=2", f"workdir={tmp_path}"],
+    )
+    with pytest.raises(ValueError, match="no tensor-parallel"):
+        Trainer(cfg)
+
+
 def test_ring_recipe_runs(tmp_path):
     """SP ring recipe (SURVEY C8) trains on a seq=4 mesh."""
     cfg = apply_overrides(
