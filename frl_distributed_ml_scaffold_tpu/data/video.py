@@ -41,9 +41,9 @@ def write_clip_shards(
     normalize offline (or here) once, not per step."""
     clips = np.asarray(clips, np.float32)
     labels = np.asarray(labels, np.int32)
-    if clips.ndim != 5 or len(clips) != len(labels):
+    if clips.ndim != 5 or labels.ndim != 1 or len(clips) != len(labels):
         raise ValueError(
-            f"clips must be (N,T,H,W,C) with matching labels; got "
+            f"clips must be (N,T,H,W,C) with matching (N,) labels; got "
             f"{clips.shape} / {labels.shape}"
         )
     os.makedirs(out_dir, exist_ok=True)

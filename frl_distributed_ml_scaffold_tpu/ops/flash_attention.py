@@ -374,6 +374,23 @@ def _block_route(q, k, interpret):
     return blocks, interpret
 
 
+def local_flash_attention(q, k, v, *, causal, interpret=None):
+    """Differentiable fused attention on LOCAL (B, T, H, D) arrays — for
+    callers already inside a shard_map region (Ulysses), where the public
+    ``flash_attention`` wrapper's own shard_map must not re-wrap. Falls back
+    to dense on untileable shapes / non-TPU, like the public entry point.
+    """
+    from frl_distributed_ml_scaffold_tpu.ops.ring_attention import dense_attention
+
+    qT = q.transpose(0, 2, 1, 3)
+    kT, vT = k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3)
+    blocks, interpret = _block_route(qT, kT, interpret)
+    if blocks is None:
+        return dense_attention(q, k, v, causal=causal)
+    bq, bk = blocks
+    return _flash(qT, kT, vT, causal, bq, bk, interpret).transpose(0, 2, 1, 3)
+
+
 def block_attention_fwd(q, k, v, *, causal, interpret=None):
     """One-block attention in kernel layout (B, H, T, D) -> (o, lse).
 

@@ -28,8 +28,14 @@ def ulysses_attention(
     *,
     axis_name: str = "seq",
     causal: bool = True,
+    interpret: bool | None = None,
 ) -> jax.Array:
-    """(B, T, H, D) attention, T sharded over ``axis_name`` (SP-Ulysses)."""
+    """(B, T, H, D) attention, T sharded over ``axis_name`` (SP-Ulysses).
+
+    The local full-sequence attention after the all_to_all runs through the
+    fused flash kernel on TPU (dense on untileable shapes / other backends),
+    so Ulysses' per-shard memory is O(block), not O(T²/n).
+    """
     env = current_mesh_env()
     if env is None or env.axis_size(axis_name) == 1:
         return dense_attention(q, k, v, causal=causal)
@@ -45,7 +51,9 @@ def ulysses_attention(
         )
 
     spec = P(BATCH_AXES, axis_name, "model", None)
-    inner = partial(_ulysses_shard_fn, axis_name=axis_name, causal=causal)
+    inner = partial(
+        _ulysses_shard_fn, axis_name=axis_name, causal=causal, interpret=interpret
+    )
     return jax.shard_map(
         inner,
         mesh=env.mesh,
@@ -55,7 +63,11 @@ def ulysses_attention(
     )(q, k, v)
 
 
-def _ulysses_shard_fn(q, k, v, *, axis_name: str, causal: bool):
+def _ulysses_shard_fn(q, k, v, *, axis_name: str, causal: bool, interpret):
+    from frl_distributed_ml_scaffold_tpu.ops.flash_attention import (
+        local_flash_attention,
+    )
+
     # seq-sharded (B, T/n, H, D) -> head-sharded (B, T, H/n, D)
     def to_heads(x):
         return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
@@ -64,5 +76,5 @@ def _ulysses_shard_fn(q, k, v, *, axis_name: str, causal: bool):
         return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
     qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
-    out = dense_attention(qh, kh, vh, causal=causal)
+    out = local_flash_attention(qh, kh, vh, causal=causal, interpret=interpret)
     return to_seq(out)

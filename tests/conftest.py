@@ -38,3 +38,28 @@ _cache_dir = os.path.join(
 jax.config.update("jax_compilation_cache_dir", _cache_dir)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+import contextlib  # noqa: E402
+import logging  # noqa: E402
+
+
+@contextlib.contextmanager
+def capture_frl_logs():
+    """Collect framework log messages. The framework logger sets
+    ``propagate=False`` (process-0 stdout gating), so pytest's ``caplog``
+    never sees its records — tests attach a handler directly instead."""
+    from frl_distributed_ml_scaffold_tpu.utils.logging import get_logger
+
+    records: list[str] = []
+
+    class _Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    logger = get_logger()
+    handler = _Capture()
+    logger.addHandler(handler)
+    try:
+        yield records
+    finally:
+        logger.removeHandler(handler)

@@ -64,23 +64,10 @@ def test_hybrid_dcn_mesh_indivisible_raises():
 def test_mesh_layout_fallback_warns():
     """Naive row-major placement must be observable, not silent (it costs
     real ICI locality on hardware)."""
-    import logging as _logging
+    from conftest import capture_frl_logs
 
-    from frl_distributed_ml_scaffold_tpu.utils.logging import get_logger
-
-    records = []
-
-    class _Capture(_logging.Handler):
-        def emit(self, record):
-            records.append(record.getMessage())
-
-    handler = _Capture()
-    logger = get_logger()
-    logger.addHandler(handler)
-    try:
+    with capture_frl_logs() as records:
         build_mesh(MeshConfig(data=4, model=2, dcn_data=2))
-    finally:
-        logger.removeHandler(handler)
     assert any("row-major" in m for m in records), records
 
 

@@ -282,6 +282,41 @@ def test_ulysses_matches_dense():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+def test_ulysses_pallas_local_attention_matches_dense():
+    """Ulysses with the fused flash kernel for its local full-T attention
+    (interpreter mode on CPU): forward and grads must match dense."""
+    from frl_distributed_ml_scaffold_tpu.ops.ring_attention import (
+        _single_shard_attention,
+    )
+    from frl_distributed_ml_scaffold_tpu.ops.ulysses import ulysses_attention
+
+    env = build_mesh(MeshConfig(data=2, seq=4))
+    set_current_mesh(env)
+    q, k, v = _rand_qkv(jax.random.key(9), b=2, t=64, h=4, d=32)
+    ref = _single_shard_attention(q, k, v, causal=True)
+    out = jax.jit(
+        lambda q, k, v: ulysses_attention(q, k, v, interpret=True)
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def loss(att):
+        def f(q, k, v):
+            o = att(q, k, v)
+            return (o * jnp.cos(jnp.arange(o.size).reshape(o.shape))).sum()
+
+        return jax.jit(jax.grad(f, argnums=(0, 1, 2)))
+
+    g_u = loss(lambda q, k, v: ulysses_attention(q, k, v, interpret=True))(q, k, v)
+    g_d = loss(lambda q, k, v: _single_shard_attention(q, k, v, causal=True))(
+        q, k, v
+    )
+    for gu, gd, name in zip(g_u, g_d, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gu), np.asarray(gd), atol=5e-5,
+            err_msg=f"ulysses-pallas grad mismatch for d{name}",
+        )
+
+
 def test_ulysses_head_divisibility_error():
     from frl_distributed_ml_scaffold_tpu.ops.ulysses import ulysses_attention
 

@@ -133,8 +133,6 @@ def test_opt_state_unmatched_leaf_warns_and_replicates():
     """ZeRO sharding silently no-ops for optimizer states that don't embed
     param-suffixed subtrees (e.g. factored states) — that must warn, not
     pass quietly (VERDICT r1 weak #7)."""
-    import logging as _logging
-
     from frl_distributed_ml_scaffold_tpu.config.schema import (
         MeshConfig,
         ParallelConfig,
@@ -143,8 +141,6 @@ def test_opt_state_unmatched_leaf_warns_and_replicates():
         opt_state_specs,
         param_specs,
     )
-    from frl_distributed_ml_scaffold_tpu.utils.logging import get_logger
-
     env = build_mesh(MeshConfig(fsdp=8))
     parallel = ParallelConfig(
         param_sharding="replicated", opt_sharding="zero1", fsdp_min_size=1024
@@ -158,19 +154,10 @@ def test_opt_state_unmatched_leaf_warns_and_replicates():
         "tiny": jnp.zeros((4,)),  # below fsdp_min_size: no warning for this
     }
 
-    records = []
+    from conftest import capture_frl_logs
 
-    class _Capture(_logging.Handler):
-        def emit(self, record):
-            records.append(record.getMessage())
-
-    handler = _Capture()
-    logger = get_logger()
-    logger.addHandler(handler)
-    try:
+    with capture_frl_logs() as records:
         specs = opt_state_specs(opt_state, params, p_specs, parallel, env.mesh)
-    finally:
-        logger.removeHandler(handler)
     assert specs["factored_v_row"] == P()
     warnings = [m for m in records if "REPLICATED" in m]
     assert len(warnings) == 1, records

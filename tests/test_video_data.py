@@ -58,23 +58,10 @@ def test_config_shape_mismatch_raises(tmp_path):
 
 
 def test_missing_dir_falls_back_with_warning(tmp_path):
-    import logging as _logging
+    from conftest import capture_frl_logs
 
-    from frl_distributed_ml_scaffold_tpu.utils.logging import get_logger
-
-    records = []
-
-    class _Capture(_logging.Handler):
-        def emit(self, record):
-            records.append(record.getMessage())
-
-    logger = get_logger()
-    handler = _Capture()
-    logger.addHandler(handler)
-    try:
+    with capture_frl_logs() as records:
         src = VideoClips(video_cfg(tmp_path / "nope"), split="train")
-    finally:
-        logger.removeHandler(handler)
     assert src.is_synthetic
     assert any("SYNTHETIC" in m for m in records)
     assert src.batch(0, 2)["video"].shape == (2, 4, 16, 16, 3)
@@ -105,27 +92,15 @@ def test_divergent_shard_shapes_raise(tmp_path):
 
 
 def test_imagenet_warns_on_missing_dir(tmp_path):
-    import logging as _logging
+    from conftest import capture_frl_logs
 
     from frl_distributed_ml_scaffold_tpu.data.imagenet import ImageNet
-    from frl_distributed_ml_scaffold_tpu.utils.logging import get_logger
 
-    records = []
-
-    class _Capture(_logging.Handler):
-        def emit(self, record):
-            records.append(record.getMessage())
-
-    logger = get_logger()
-    handler = _Capture()
-    logger.addHandler(handler)
-    try:
+    with capture_frl_logs() as records:
         src = ImageNet(
             DataConfig(name="imagenet", data_dir=str(tmp_path / "nope")),
             split="train",
         )
-    finally:
-        logger.removeHandler(handler)
     assert src.is_synthetic
     assert any("SYNTHETIC" in m for m in records)
 

@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Single-chip perf bisection for the RN50/ViT-B headline configs.
+
+Run on a live TPU to localize where step time goes before optimizing
+(BASELINE.md backlog). Each experiment is one JSONL line to stdout;
+timing uses device_get of the loss (the axon relay's block_until_ready
+reports donated buffers ready immediately — see utils/timing.py).
+
+    python tools/perf_sweep.py            # full sweep
+    python tools/perf_sweep.py rn50_bs    # one experiment group
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+
+def timed_steps(trainer, state, batch, n=12, warm=3):
+    import jax
+
+    for _ in range(warm):
+        state, m = trainer.train_step(state, batch)
+    jax.device_get(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(n):
+        state, m = trainer.train_step(state, batch)
+    jax.device_get(m["loss"])
+    return (time.perf_counter() - t0) / n
+
+
+def build(name, overrides):
+    from frl_distributed_ml_scaffold_tpu.config import apply_overrides, get_config
+    from frl_distributed_ml_scaffold_tpu.trainer.loop import Trainer
+
+    cfg = apply_overrides(
+        get_config(name),
+        ["data.prefetch=0", "trainer.log_every=1000000"] + overrides,
+    )
+    trainer = Trainer(cfg)
+    state = trainer.init_state()
+    batch = trainer.pipeline.global_batch(0)
+    return trainer, state, batch
+
+
+def emit(tag, bs, dt, extra=None):
+    rec = {
+        "experiment": tag,
+        "global_batch_size": bs,
+        "step_time_ms": round(dt * 1e3, 2),
+        "samples_per_sec_per_chip": round(bs / dt, 1),
+    }
+    rec.update(extra or {})
+    print(json.dumps(rec), flush=True)
+
+
+def rn50_bs():
+    """Throughput knee: where does adding batch stop helping?"""
+    for bs in (256, 512, 768, 1024):
+        t, s, b = build("imagenet_rn50_ddp", [f"data.global_batch_size={bs}"])
+        emit("rn50_bs", bs, timed_steps(t, s, b))
+
+
+def rn50_precision():
+    for policy in ("bf16_mixed", "bf16", "fp32"):
+        t, s, b = build(
+            "imagenet_rn50_ddp",
+            ["data.global_batch_size=512", f"precision.policy={policy}"],
+        )
+        emit("rn50_precision", 512, timed_steps(t, s, b), {"policy": policy})
+
+
+def rn50_fwd_only():
+    """Eval step ~= forward: splits fwd from bwd+update in the step time."""
+    import jax
+
+    t, s, b = build("imagenet_rn50_ddp", ["data.global_batch_size=512"])
+    emit("rn50_train", 512, timed_steps(t, s, b))
+    for _ in range(3):
+        m = t.eval_step(s, b)
+    jax.device_get(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(10):
+        m = t.eval_step(s, b)
+    jax.device_get(m["loss"])
+    emit("rn50_eval_fwd", 512, (time.perf_counter() - t0) / 10)
+
+
+def rn50_depth():
+    """Stem vs body: depth-18 shares the stem; scaling with depth separates
+    the (fixed) stem+head cost from the residual body."""
+    for depth in (18, 34, 50):
+        t, s, b = build(
+            "imagenet_rn50_ddp",
+            ["data.global_batch_size=512", f"model.depth={depth}"],
+        )
+        emit("rn50_depth", 512, timed_steps(t, s, b), {"depth": depth})
+
+
+def vitb():
+    for bs in (128, 256, 512):
+        t, s, b = build("imagenet_vitb_fsdp", [f"data.global_batch_size={bs}"])
+        emit("vitb_bs", bs, timed_steps(t, s, b))
+
+
+GROUPS = {f.__name__: f for f in (rn50_bs, rn50_precision, rn50_fwd_only,
+                                  rn50_depth, vitb)}
+
+if __name__ == "__main__":
+    which = sys.argv[1:] or list(GROUPS)
+    for g in which:
+        try:
+            GROUPS[g]()
+        except Exception as e:  # keep sweeping; record the failure
+            print(json.dumps({"experiment": g, "error": str(e)[:200]}),
+                  flush=True)
