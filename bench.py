@@ -120,6 +120,9 @@ def protocol_record(cfg, trainer, perf, *, step_flops: float = 0.0) -> dict:
         "step_time_median_s": round(perf["step_time_median_s"], 6),
         "step_time_p90_s": round(perf["step_time_p90_s"], 6),
     }
+    from frl_distributed_ml_scaffold_tpu.utils.profiling import device_memory_stats
+
+    rec.update(device_memory_stats())
     if step_flops > 0:
         rec["model_flops_per_sample"] = round(
             step_flops / cfg.data.global_batch_size

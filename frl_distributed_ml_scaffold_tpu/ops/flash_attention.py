@@ -386,6 +386,14 @@ def local_flash_attention(q, k, v, *, causal, interpret=None):
     kT, vT = k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3)
     blocks, interpret = _block_route(qT, kT, interpret)
     if blocks is None:
+        if not _interpret_default():
+            # On TPU this is a real perf/memory cliff (O(T_local²) dense
+            # instead of the fused kernel) — same warn-once contract as the
+            # public wrapper. Off-TPU dense is the intended default.
+            _warn_fallback(
+                "local_flash_attention falling back to dense: shape "
+                f"(T={q.shape[1]}, head_dim={q.shape[3]}) is not tileable"
+            )
         return dense_attention(q, k, v, causal=causal)
     bq, bk = blocks
     return _flash(qT, kT, vT, causal, bq, bk, interpret).transpose(0, 2, 1, 3)

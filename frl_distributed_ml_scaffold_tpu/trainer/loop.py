@@ -278,6 +278,7 @@ class Trainer:
             WindowProfiler,
             annotate,
             annotate_step,
+            device_memory_stats,
         )
 
         profiler = WindowProfiler(
@@ -304,6 +305,7 @@ class Trainer:
                             for k, v in perf.items()
                             if k in ("step_time_median_s", "samples_per_sec_per_chip")
                         },
+                        **device_memory_stats(),
                     }
                     last_record = metric_logger.log(step + 1, metrics, extra)
                 if on_step is not None:

@@ -85,3 +85,24 @@ def annotate(name: str) -> Iterator[None]:
 def annotate_step(step: int):
     """Named per-step annotation — groups a step's dispatch in the trace."""
     return jax.profiler.StepTraceAnnotation("train", step_num=step)
+
+
+def device_memory_stats() -> dict[str, float]:
+    """Per-device HBM usage in GiB (the ``torch.cuda.memory_summary``
+    equivalent — SURVEY §5 observability). Empty when the backend exposes
+    no stats (CPU simulation); never raises — observability must not be
+    able to kill a run."""
+    try:
+        dev = jax.local_devices()[0]
+        stats = dev.memory_stats() or {}
+    except Exception:
+        return {}
+    gib = 1024**3
+    out = {}
+    if "bytes_in_use" in stats:
+        out["hbm_in_use_gib"] = round(stats["bytes_in_use"] / gib, 3)
+    if "peak_bytes_in_use" in stats:
+        out["hbm_peak_gib"] = round(stats["peak_bytes_in_use"] / gib, 3)
+    if "bytes_limit" in stats:
+        out["hbm_limit_gib"] = round(stats["bytes_limit"] / gib, 3)
+    return out

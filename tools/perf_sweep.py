@@ -20,6 +20,9 @@ sys.path.insert(0, ".")
 
 
 def timed_steps(trainer, state, batch, n=12, warm=3):
+    """Returns (per-step seconds, final state). The final state matters:
+    train_step donates its input state, so callers must NEVER reuse the
+    state they passed in (deleted buffers on real TPU)."""
     import jax
 
     for _ in range(warm):
@@ -29,7 +32,7 @@ def timed_steps(trainer, state, batch, n=12, warm=3):
     for _ in range(n):
         state, m = trainer.train_step(state, batch)
     jax.device_get(m["loss"])
-    return (time.perf_counter() - t0) / n
+    return (time.perf_counter() - t0) / n, state
 
 
 def build(name, overrides):
@@ -61,7 +64,8 @@ def rn50_bs():
     """Throughput knee: where does adding batch stop helping?"""
     for bs in (256, 512, 768, 1024):
         t, s, b = build("imagenet_rn50_ddp", [f"data.global_batch_size={bs}"])
-        emit("rn50_bs", bs, timed_steps(t, s, b))
+        dt, _ = timed_steps(t, s, b)
+        emit("rn50_bs", bs, dt)
 
 
 def rn50_precision():
@@ -70,7 +74,8 @@ def rn50_precision():
             "imagenet_rn50_ddp",
             ["data.global_batch_size=512", f"precision.policy={policy}"],
         )
-        emit("rn50_precision", 512, timed_steps(t, s, b), {"policy": policy})
+        dt, _ = timed_steps(t, s, b)
+        emit("rn50_precision", 512, dt, {"policy": policy})
 
 
 def rn50_fwd_only():
@@ -78,7 +83,8 @@ def rn50_fwd_only():
     import jax
 
     t, s, b = build("imagenet_rn50_ddp", ["data.global_batch_size=512"])
-    emit("rn50_train", 512, timed_steps(t, s, b))
+    dt, s = timed_steps(t, s, b)  # s was donated; use the returned state
+    emit("rn50_train", 512, dt)
     for _ in range(3):
         m = t.eval_step(s, b)
     jax.device_get(m["loss"])
@@ -97,13 +103,15 @@ def rn50_depth():
             "imagenet_rn50_ddp",
             ["data.global_batch_size=512", f"model.depth={depth}"],
         )
-        emit("rn50_depth", 512, timed_steps(t, s, b), {"depth": depth})
+        dt, _ = timed_steps(t, s, b)
+        emit("rn50_depth", 512, dt, {"depth": depth})
 
 
 def vitb():
     for bs in (128, 256, 512):
         t, s, b = build("imagenet_vitb_fsdp", [f"data.global_batch_size={bs}"])
-        emit("vitb_bs", bs, timed_steps(t, s, b))
+        dt, _ = timed_steps(t, s, b)
+        emit("vitb_bs", bs, dt)
 
 
 GROUPS = {f.__name__: f for f in (rn50_bs, rn50_precision, rn50_fwd_only,
