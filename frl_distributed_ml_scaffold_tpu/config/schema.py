@@ -187,6 +187,9 @@ class ResNetConfig:
     depth: int = 50  # 18 | 34 | 50 | 101 | 152
     num_classes: int = 1000
     width_multiplier: int = 1
+    # "conv7" = torchvision 7x7/s2 stem; "s2d" = the mathematically exact
+    # space-to-depth rewrite (MXU-friendly; see models/resnet.py).
+    stem: str = "conv7"
 
 
 @dataclass(frozen=True)

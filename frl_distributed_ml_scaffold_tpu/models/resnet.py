@@ -30,6 +30,50 @@ STAGE_SIZES = {
 BOTTLENECK = {18: False, 34: False, 50: True, 101: True, 152: True}
 
 
+def space_to_depth(x: jnp.ndarray, block: int = 2) -> jnp.ndarray:
+    """NHWC space-to-depth: (B, H, W, C) -> (B, H/b, W/b, b*b*C), channel
+    packing ``(di, dj, c)`` row-major (the order ``s2d_stem_weights``
+    assumes)."""
+    b, h, w, c = x.shape
+    if h % block or w % block:
+        raise ValueError(
+            f"space_to_depth stem needs spatial dims divisible by {block}; "
+            f"got {h}x{w} — use stem='conv7' for odd input sizes"
+        )
+    x = x.reshape(b, h // block, block, w // block, block, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, h // block, w // block, block * block * c)
+
+
+def s2d_stem_weights(w7: jnp.ndarray) -> jnp.ndarray:
+    """Exact rewrite of a (7, 7, C, F) stride-2 SAME stem kernel as the
+    (4, 4, 4C, F) stride-1 kernel over space-to-depth(2) input.
+
+    Derivation: SAME padding for k=7, s=2 pads (2, 3), so output pixel x
+    reads input a = 2x + i - 2, i in [0, 7). In s2d coordinates a = 2u + di
+    with u = x + k - 1, hence i = 2k + di for tap k in [0, 4) — the 7x7
+    taps relabel one-to-one onto (k, di) with (3, 1) (i.e. i == 7) zero.
+    The MLPerf RN50-on-TPU stem trick, kept mathematically exact so the
+    equivalence test can assert it.
+    """
+    k7, _, c, f = w7.shape
+    assert k7 == 7
+    w4 = jnp.zeros((4, 4, 4 * c, f), w7.dtype)
+    for kh in range(4):
+        for dh in range(2):
+            ih = 2 * kh + dh
+            if ih >= 7:
+                continue
+            for kw in range(4):
+                for dw in range(2):
+                    iw = 2 * kw + dw
+                    if iw >= 7:
+                        continue
+                    ch = (dh * 2 + dw) * c
+                    w4 = w4.at[kh, kw, ch : ch + c, :].set(w7[ih, iw])
+    return w4
+
+
 class BottleneckBlock(nn.Module):
     filters: int
     strides: int
@@ -96,7 +140,24 @@ class ResNet(nn.Module):
             dtype=dtype,  # compute in bf16, stats kept fp32 by flax
         )
         x = x.astype(dtype)
-        x = conv(64 * cfg.width_multiplier, (7, 7), strides=(2, 2))(x)
+        if cfg.stem == "s2d":
+            # MLPerf stem: the 7x7/s2 conv reads a 3-channel input, which
+            # pads terribly onto the MXU's 128-lane tiles. Space-to-depth(2)
+            # expresses the same function (see s2d_stem_weights for the
+            # exact weight relabeling) as a 4x4/s1 conv over 12 channels at
+            # quarter spatial size — a denser, MXU-friendlier contraction.
+            x = space_to_depth(x, 2)
+            x = conv(
+                64 * cfg.width_multiplier, (4, 4), strides=(1, 1), name="stem_s2d"
+            )(x)
+        elif cfg.stem == "conv7":
+            x = conv(64 * cfg.width_multiplier, (7, 7), strides=(2, 2))(x)
+        else:
+            # Silent config typos are how benchmarks lie (config/core.py):
+            # an unknown stem must not quietly benchmark conv7 twice.
+            raise ValueError(
+                f"unknown ResNet stem {cfg.stem!r}; expected 'conv7' or 's2d'"
+            )
         x = norm()(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")

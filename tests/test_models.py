@@ -83,6 +83,41 @@ def test_video_forward():
     assert logits.shape == (2, 11)
 
 
+def test_s2d_stem_is_exact_rewrite_of_conv7():
+    """The space-to-depth stem must compute the SAME function as the 7x7/s2
+    SAME-padded stem under the documented weight relabeling — it is a perf
+    knob, not an architecture change."""
+    from frl_distributed_ml_scaffold_tpu.models.resnet import (
+        s2d_stem_weights,
+        space_to_depth,
+    )
+
+    key = jax.random.key(0)
+    x = jax.random.normal(key, (2, 32, 32, 3))
+    w7 = jax.random.normal(jax.random.key(1), (7, 7, 3, 16))
+
+    ref = jax.lax.conv_general_dilated(
+        x, w7, window_strides=(2, 2), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    out = jax.lax.conv_general_dilated(
+        space_to_depth(x, 2), s2d_stem_weights(w7), window_strides=(1, 1),
+        padding="SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    # fp32 accumulation-order noise only — a wrong tap relabeling would be
+    # O(1) wrong everywhere, not 1e-5 on isolated elements.
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+
+def test_resnet_s2d_stem_trains():
+    model = create_model(
+        ResNetConfig(depth=18, num_classes=7, stem="s2d"), FP32
+    )
+    x = jnp.ones((2, 32, 32, 3))
+    _, logits = init_and_forward(model, x)
+    assert logits.shape == (2, 7)
+
+
 def tiny_gpt(**kw):
     defaults = dict(
         vocab_size=64, num_layers=2, num_heads=4, hidden_dim=32, seq_len=16

@@ -107,6 +107,17 @@ def rn50_depth():
         emit("rn50_depth", 512, dt, {"depth": depth})
 
 
+def rn50_stem():
+    """conv7 vs the exact space-to-depth rewrite (MLPerf stem)."""
+    for stem in ("conv7", "s2d"):
+        t, s, b = build(
+            "imagenet_rn50_ddp",
+            ["data.global_batch_size=512", f"model.stem={stem}"],
+        )
+        dt, _ = timed_steps(t, s, b)
+        emit("rn50_stem", 512, dt, {"stem": stem})
+
+
 def vitb():
     for bs in (128, 256, 512):
         t, s, b = build("imagenet_vitb_fsdp", [f"data.global_batch_size={bs}"])
@@ -115,7 +126,7 @@ def vitb():
 
 
 GROUPS = {f.__name__: f for f in (rn50_bs, rn50_precision, rn50_fwd_only,
-                                  rn50_depth, vitb)}
+                                  rn50_depth, rn50_stem, vitb)}
 
 if __name__ == "__main__":
     which = sys.argv[1:] or list(GROUPS)
