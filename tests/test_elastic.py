@@ -83,6 +83,44 @@ def test_topology_change_restore(tmp_path):
     t4.checkpointer.close()
 
 
+def test_topology_grow_and_strategy_change_restore(tmp_path):
+    """Elastic-grow + strategy change: a checkpoint written by a 4-device
+    replicated-params run restores into an 8-device FSDP-sharded trainer —
+    values identical, placement per the NEW sharding rules."""
+    cfg4 = ckpt_cfg(tmp_path, ["mesh.data=4", "trainer.total_steps=3"])
+    t4 = Trainer(cfg4, mesh_env=build_mesh(cfg4.mesh, devices=jax.devices()[:4]))
+    state4, _ = t4.fit()
+    t4.checkpointer.close()
+
+    cfg8 = ckpt_cfg(
+        tmp_path,
+        [
+            "mesh.data=1",
+            "mesh.fsdp=8",
+            "trainer.total_steps=3",
+            "parallel.param_sharding=fsdp",
+            "parallel.fsdp_min_size=64",
+        ],
+    )
+    t8 = Trainer(cfg8, mesh_env=build_mesh(cfg8.mesh))
+    restored = t8.checkpointer.restore_or_init(t8)
+    assert int(jax.device_get(restored.step)) == 3
+    assert_params_close(restored.params, state4.params)
+    # Placement follows the new trainer's FSDP specs, not the saved layout.
+    big = [l for l in jax.tree.leaves(restored.params) if l.size >= 64]
+    assert big and all(
+        any(
+            "fsdp" in (e or ()) if isinstance(e, tuple) else e == "fsdp"
+            for e in l.sharding.spec
+        )
+        for l in big
+    )
+    batch = t8.pipeline.global_batch(3)
+    _, metrics = t8.train_step(restored, batch)
+    assert np.isfinite(float(metrics["loss"]))
+    t8.checkpointer.close()
+
+
 def test_fault_hook_fires_once(tmp_path, monkeypatch):
     """The injection hook is one-shot per workdir (marker file)."""
     from frl_distributed_ml_scaffold_tpu.launcher.elastic import fault_hook_from_env

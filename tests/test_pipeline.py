@@ -134,6 +134,32 @@ def test_pp_composes_with_ring_attention_grads(tmp_path):
     assert np.isfinite(float(metrics["loss"]))
 
 
+def test_pp_composes_with_remat(tmp_path):
+    """PP x activation checkpointing: rematerializing through the rolling-
+    buffer schedule must not change the math (it is the lever that keeps
+    GPipe's saved-per-tick activations from bounding pipeline depth)."""
+    ref = make_gpt_trainer(
+        tmp_path / "ref",
+        ["model.pipeline_stages=2", "model.pipeline_microbatches=2",
+         "mesh.pipe=2", "mesh.data=4", "trainer.remat=none"],
+    )
+    ref_state, _ = run_steps(ref, ref.init_state(), steps=3)
+    for mode in ("full", "dots"):
+        tr = make_gpt_trainer(
+            tmp_path / mode,
+            ["model.pipeline_stages=2", "model.pipeline_microbatches=2",
+             "mesh.pipe=2", "mesh.data=4", f"trainer.remat={mode}"],
+        )
+        state, _ = run_steps(tr, tr.init_state(), steps=3)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4
+            ),
+            jax.device_get(ref_state.params),
+            jax.device_get(state.params),
+        )
+
+
 def test_pp_composes_with_ulysses_attention():
     """Ulysses' all_to_all shard_map also batches over the stage vmap."""
     from frl_distributed_ml_scaffold_tpu.config.schema import MeshConfig
