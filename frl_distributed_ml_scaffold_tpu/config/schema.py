@@ -98,14 +98,14 @@ class PrecisionConfig:
 
 @dataclass(frozen=True)
 class OptimizerConfig:
-    name: str = "adamw"  # adamw | sgd | adam
+    name: str = "adamw"  # adamw | sgd | adam | adafactor
     learning_rate: float = 1e-3
     warmup_steps: int = 0
     schedule: str = "constant"  # constant | cosine | linear
     weight_decay: float = 0.0
     b1: float = 0.9
     b2: float = 0.999
-    eps: float = 1e-8
+    eps: float = 1e-8  # adam family only (adafactor keeps optax's 1e-30)
     momentum: float = 0.9  # sgd only
     grad_clip_norm: Optional[float] = None
 

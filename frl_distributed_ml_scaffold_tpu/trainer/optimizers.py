@@ -54,6 +54,22 @@ def make_optimizer(
         if cfg.weight_decay:
             parts.append(optax.add_decayed_weights(cfg.weight_decay))
         parts.append(optax.sgd(schedule, momentum=cfg.momentum, nesterov=True))
+    elif cfg.name == "adafactor":
+        # Sublinear-memory LM optimizer (factored second moment). Note for
+        # ZeRO users: its v_row/v_col state leaves are not param-shaped, so
+        # opt_sharding=zero1 cannot mirror param specs onto them — they
+        # stay replicated and partition.opt_state_specs warns (they are
+        # sublinear in size, so the lost sharding is small by design).
+        # cfg.eps is the Adam-family epsilon (default 1e-8); Adafactor's
+        # canonical eps is 1e-30 and passing Adam's would floor the RMS
+        # denominator 22 orders of magnitude too high — use optax's own
+        # default rather than silently changing Adafactor's update rule.
+        parts.append(
+            optax.adafactor(
+                schedule,
+                weight_decay_rate=cfg.weight_decay or None,
+            )
+        )
     else:
         raise KeyError(f"unknown optimizer {cfg.name!r}")
     return optax.chain(*parts), schedule

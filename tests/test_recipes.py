@@ -284,6 +284,50 @@ def test_resnet_refuses_model_axis(tmp_path):
         Trainer(cfg)
 
 
+def test_gpt_adafactor_trains_and_zero1_warns(tmp_path):
+    """Adafactor (sublinear-memory LM optimizer) trains; under zero1 its
+    factored v_row/v_col leaves can't mirror param specs and the partition
+    layer's replication warning fires — the guard working on a real
+    optimizer, not just a synthetic state tree."""
+    from conftest import capture_frl_logs
+
+    cfg = apply_overrides(
+        get_config("gpt2_medium_zero1"),
+        [
+            "model.vocab_size=128",
+            "model.num_layers=2",
+            "model.num_heads=4",
+            # >= optax's min_dim_size_to_factor (128) on two dims, so the
+            # second moment actually factors into v_row/v_col.
+            "model.hidden_dim=128",
+            "model.seq_len=32",
+            "data.vocab_size=128",
+            "data.seq_len=32",
+            "data.global_batch_size=16",
+            "optimizer.name=adafactor",
+            "optimizer.learning_rate=0.01",
+            "optimizer.warmup_steps=0",
+            "trainer.grad_accum=1",
+            "trainer.log_every=1000",
+            "precision.policy=fp32",
+            "checkpoint.enabled=false",
+            "mesh.fsdp=8",
+            "parallel.fsdp_min_size=64",
+            f"workdir={tmp_path}",
+        ],
+    )
+    with capture_frl_logs() as records:
+        trainer = Trainer(cfg)
+    state = trainer.init_state()
+    losses = []
+    for step in range(6):
+        batch = trainer.pipeline.global_batch(step)
+        state, metrics = trainer.train_step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert np.isfinite(losses).all() and losses[-1] < losses[0], losses
+    assert any("REPLICATED" in m for m in records), records
+
+
 def test_ring_recipe_runs(tmp_path):
     """SP ring recipe (SURVEY C8) trains on a seq=4 mesh."""
     cfg = apply_overrides(
