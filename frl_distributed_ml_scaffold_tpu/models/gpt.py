@@ -174,6 +174,7 @@ class GPT(nn.Module):
             # mode exclusions.
             from frl_distributed_ml_scaffold_tpu.parallel.pipeline import (
                 SpmdPipeline,
+                effective_microbatches,
             )
 
             pipe = SpmdPipeline(
@@ -181,7 +182,7 @@ class GPT(nn.Module):
                 (cfg, dtype, train),
                 num_layers=cfg.num_layers,
                 num_stages=cfg.pipeline_stages,
-                num_microbatches=cfg.pipeline_microbatches or cfg.pipeline_stages,
+                num_microbatches=effective_microbatches(cfg),
                 name="pipeline",
             )
             x, aux_loss = pipe(x, jnp.zeros((), jnp.float32))

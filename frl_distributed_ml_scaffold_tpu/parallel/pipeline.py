@@ -44,6 +44,17 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from frl_distributed_ml_scaffold_tpu.dist.mesh import BATCH_AXES, current_mesh_env
 
 
+def effective_microbatches(model_cfg) -> int:
+    """The one resolution rule for the pipeline microbatch count: the
+    configured value, defaulting to the stage count (minimum bubble-free
+    fill). Single source of truth for the model, trainer init sizing, and
+    bubble-fraction logging."""
+    stages = getattr(model_cfg, "pipeline_stages", 1)
+    if stages <= 1:
+        return 1
+    return getattr(model_cfg, "pipeline_microbatches", 0) or stages
+
+
 def _constrain(x: jax.Array, *leading_axes) -> jax.Array:
     """Sharding-constrain the leading dims of ``x`` (no-op without a mesh)."""
     env = current_mesh_env()

@@ -106,12 +106,11 @@ class Trainer:
         # The init example must stay batch-axis-divisible AFTER the pipeline
         # splits it into microbatches (each microbatch crosses the ring/
         # Ulysses shard_map batch specs on its own).
-        stages = getattr(self.cfg.model, "pipeline_stages", 1)
-        micro = (
-            (getattr(self.cfg.model, "pipeline_microbatches", 0) or stages)
-            if stages > 1
-            else 1
+        from frl_distributed_ml_scaffold_tpu.parallel.pipeline import (
+            effective_microbatches,
         )
+
+        micro = effective_microbatches(self.cfg.model)
         x = example_input(
             self.cfg.data, self.cfg.model, batch_size=self.env.batch_axis_size * micro
         )
@@ -167,6 +166,22 @@ class Trainer:
             n_params / 1e6,
             dict(self.env.mesh.shape),
         )
+        stages = getattr(self.cfg.model, "pipeline_stages", 1)
+        if stages > 1:
+            from frl_distributed_ml_scaffold_tpu.parallel.pipeline import (
+                effective_microbatches,
+            )
+
+            micro = effective_microbatches(self.cfg.model)
+            # GPipe fill/drain cost — the number to watch when tuning
+            # pipeline_microbatches (amortizes as M grows).
+            self.logger.info(
+                "pipeline: %d stages x %d microbatches, bubble fraction "
+                "(S-1)/(M+S-1) = %.3f",
+                stages,
+                micro,
+                (stages - 1) / (micro + stages - 1),
+            )
         return state
 
     def _batch_shardings(self, batch: dict) -> dict:

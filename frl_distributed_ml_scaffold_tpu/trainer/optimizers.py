@@ -29,6 +29,36 @@ def make_schedule(cfg: OptimizerConfig, total_steps: int) -> optax.Schedule:
     return sched
 
 
+def _decoupled_decay(
+    weight_decay: float, schedule: optax.Schedule
+) -> optax.GradientTransformation:
+    """AdamW-style decoupled weight decay: update -= lr(step) * wd * param.
+
+    Runs AFTER the optimizer in the chain (whose output is already the
+    final descent update including the -lr scaling), so the decay term is
+    added directly in update space.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def init_fn(params):
+        del params
+        return optax.ScaleByScheduleState(count=jnp.zeros([], jnp.int32))
+
+    def update_fn(updates, state, params):
+        if params is None:
+            raise ValueError("decoupled decay requires params")
+        lr = schedule(state.count)
+        updates = jax.tree.map(
+            lambda u, p: u - lr * weight_decay * p, updates, params
+        )
+        return updates, optax.ScaleByScheduleState(
+            count=optax.safe_int32_increment(state.count)
+        )
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
 def make_optimizer(
     cfg: OptimizerConfig, trainer_cfg: TrainerConfig
 ) -> tuple[optax.GradientTransformation, optax.Schedule]:
@@ -64,12 +94,14 @@ def make_optimizer(
         # canonical eps is 1e-30 and passing Adam's would floor the RMS
         # denominator 22 orders of magnitude too high — use optax's own
         # default rather than silently changing Adafactor's update rule.
-        parts.append(
-            optax.adafactor(
-                schedule,
-                weight_decay_rate=cfg.weight_decay or None,
-            )
-        )
+        parts.append(optax.adafactor(schedule))
+        if cfg.weight_decay:
+            # optax.adafactor's own weight_decay_rate is a RAW per-step
+            # multiplier (not lr-scaled): a config tuned for adamw
+            # (decay/step = lr*wd) would decay ~1000x too hard. Apply
+            # AdamW-semantics decoupled decay instead so weight_decay means
+            # the same thing for every optimizer here.
+            parts.append(_decoupled_decay(cfg.weight_decay, schedule))
     else:
         raise KeyError(f"unknown optimizer {cfg.name!r}")
     return optax.chain(*parts), schedule

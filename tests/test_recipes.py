@@ -284,6 +284,38 @@ def test_resnet_refuses_model_axis(tmp_path):
         Trainer(cfg)
 
 
+def test_adafactor_weight_decay_is_adamw_semantics():
+    """weight_decay must mean the same thing for every optimizer: per-step
+    decay = lr * wd (decoupled), NOT optax.adafactor's raw multiplier
+    (which would decay ~1/lr times harder for adamw-tuned configs)."""
+    import jax.numpy as jnp
+
+    from frl_distributed_ml_scaffold_tpu.config.schema import (
+        OptimizerConfig,
+        TrainerConfig,
+    )
+    from frl_distributed_ml_scaffold_tpu.trainer.optimizers import make_optimizer
+
+    lr, wd = 1e-2, 0.1
+    params = {"w": jnp.full((4,), 2.0)}
+    grads = {"w": jnp.zeros((4,))}  # zero grads isolate the decay term
+
+    tx, _ = make_optimizer(
+        OptimizerConfig(
+            name="adafactor", learning_rate=lr, weight_decay=wd,
+            schedule="constant", grad_clip_norm=None,
+        ),
+        TrainerConfig(total_steps=10),
+    )
+    state = tx.init(params)
+    updates, _ = tx.update(grads, state, params)
+    # update == -lr * wd * param exactly (zero gradient contribution).
+    np.testing.assert_allclose(
+        np.asarray(updates["w"]), -lr * wd * np.asarray(params["w"]),
+        rtol=1e-6,
+    )
+
+
 def test_gpt_adafactor_trains_and_zero1_warns(tmp_path):
     """Adafactor (sublinear-memory LM optimizer) trains; under zero1 its
     factored v_row/v_col leaves can't mirror param specs and the partition
