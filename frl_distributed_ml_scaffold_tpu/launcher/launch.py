@@ -42,6 +42,12 @@ def _parse_args(argv=None):
         help="restore the latest checkpoint and run the eval loop only",
     )
     p.add_argument(
+        "--describe",
+        action="store_true",
+        help="print resolved config, mesh, parameter shardings, FLOPs and "
+        "pipeline bubble, then exit without training (dry run)",
+    )
+    p.add_argument(
         "--coordinator", default=None, help="host:port for multi-host bring-up"
     )
     p.add_argument(
@@ -138,12 +144,73 @@ def main(argv=None) -> int:
 
     sanitize_from_env()  # FRL_TPU_SANITIZE=nans,infs,leaks (SURVEY §5)
     logger = get_logger()
+    if args.describe:
+        return describe(cfg)  # prints the resolved config itself
     logger.info("launching %s\n%s", cfg.name, pretty_config(cfg))
     if args.eval_only:
         last = run_eval(cfg)
     else:
         _, last = run_experiment(cfg)
     logger.info("done: %s", json.dumps(last, default=str))
+    return 0
+
+
+def describe(cfg) -> int:
+    """Dry run: resolve everything a training run would — mesh, sharding
+    specs, per-step FLOPs, pipeline bubble — and print it. Nothing trains;
+    nothing is written (checkpointing and prefetch are forced off so no
+    directory is created and no worker thread started)."""
+    import numpy as np
+
+    from frl_distributed_ml_scaffold_tpu.config import apply_overrides, pretty_config
+    from frl_distributed_ml_scaffold_tpu.parallel.pipeline import pipeline_summary
+    from frl_distributed_ml_scaffold_tpu.trainer.loop import Trainer
+    from frl_distributed_ml_scaffold_tpu.trainer.tasks import example_input
+    from frl_distributed_ml_scaffold_tpu.utils.flops import fn_flops
+    from frl_distributed_ml_scaffold_tpu.utils.trees import tree_path_names
+
+    _assert_no_cuda_imports()
+    print(pretty_config(cfg))
+    cfg = apply_overrides(cfg, ["checkpoint.enabled=false", "data.prefetch=0"])
+    trainer = Trainer(cfg)
+    print(f"\nmesh: {dict(trainer.env.mesh.shape)} "
+          f"({trainer.env.num_devices} devices)")
+    summary = pipeline_summary(cfg.model)
+    if summary:
+        print(summary)
+
+    shapes = trainer.state_shapes.params
+    specs = trainer.state_specs.params
+    names = tree_path_names(shapes)
+    import jax
+
+    total = 0
+    print(f"\n{'parameter':58s} {'shape':20s} sharding")
+    for name, shape_leaf, spec in zip(
+        names, jax.tree.leaves(shapes), jax.tree.leaves(
+            specs, is_leaf=lambda x: hasattr(x, "index") and not hasattr(x, "shape")
+        )
+    ):
+        total += int(np.prod(shape_leaf.shape))
+        print(f"{name:58s} {str(tuple(shape_leaf.shape)):20s} {spec}")
+    print(f"\ntotal params: {total / 1e6:.2f}M")
+
+    # The real global batch size — divisible by every axis/accum factor by
+    # construction (only shapes are traced; nothing is materialized on
+    # device).
+    x = example_input(
+        cfg.data, cfg.model, batch_size=cfg.data.global_batch_size
+    )
+    batch = {k: np.asarray(v) for k, v in x.items()}
+    try:
+        flops = trainer._mesh_scoped(fn_flops)(
+            trainer._train_step_fn, trainer.state_shapes, batch
+        )
+        per_sample = flops / batch[next(iter(batch))].shape[0]
+        print(f"train-step FLOPs (example batch): {flops / 1e9:.2f} G "
+              f"({per_sample / 1e9:.2f} G/sample)")
+    except Exception as e:  # describe must never fail a dry run
+        print(f"train-step FLOPs: unavailable ({type(e).__name__}: {e})")
     return 0
 
 

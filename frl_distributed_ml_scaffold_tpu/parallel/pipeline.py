@@ -55,6 +55,20 @@ def effective_microbatches(model_cfg) -> int:
     return getattr(model_cfg, "pipeline_microbatches", 0) or stages
 
 
+def pipeline_summary(model_cfg) -> str | None:
+    """One-line human summary incl. the GPipe bubble fraction, or None when
+    the model isn't pipelined — the single place the formula lives."""
+    stages = getattr(model_cfg, "pipeline_stages", 1)
+    if stages <= 1:
+        return None
+    micro = effective_microbatches(model_cfg)
+    bubble = (stages - 1) / (micro + stages - 1)
+    return (
+        f"pipeline: {stages} stages x {micro} microbatches, "
+        f"bubble fraction (S-1)/(M+S-1) = {bubble:.3f}"
+    )
+
+
 def _constrain(x: jax.Array, *leading_axes) -> jax.Array:
     """Sharding-constrain the leading dims of ``x`` (no-op without a mesh)."""
     env = current_mesh_env()

@@ -166,22 +166,15 @@ class Trainer:
             n_params / 1e6,
             dict(self.env.mesh.shape),
         )
-        stages = getattr(self.cfg.model, "pipeline_stages", 1)
-        if stages > 1:
-            from frl_distributed_ml_scaffold_tpu.parallel.pipeline import (
-                effective_microbatches,
-            )
+        from frl_distributed_ml_scaffold_tpu.parallel.pipeline import (
+            pipeline_summary,
+        )
 
-            micro = effective_microbatches(self.cfg.model)
+        summary = pipeline_summary(self.cfg.model)
+        if summary:
             # GPipe fill/drain cost — the number to watch when tuning
             # pipeline_microbatches (amortizes as M grows).
-            self.logger.info(
-                "pipeline: %d stages x %d microbatches, bubble fraction "
-                "(S-1)/(M+S-1) = %.3f",
-                stages,
-                micro,
-                (stages - 1) / (micro + stages - 1),
-            )
+            self.logger.info("%s", summary)
         return state
 
     def _batch_shardings(self, batch: dict) -> dict:

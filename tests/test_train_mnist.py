@@ -64,3 +64,26 @@ def test_launcher_cli_runs(tmp_path, capsys):
         ]
     )
     assert rc == 0
+
+
+def test_launcher_describe_dry_run(tmp_path, capsys):
+    """--describe prints mesh + per-param shardings + FLOPs and trains
+    nothing (no metrics.jsonl is written)."""
+    from frl_distributed_ml_scaffold_tpu.launcher.launch import main
+
+    rc = main(
+        [
+            "--config=mnist_mlp",
+            "--device=cpu",
+            "--describe",
+            "data.global_batch_size=32",
+            "model.hidden_sizes=32",
+            f"workdir={tmp_path}",
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "mesh:" in out
+    assert "PartitionSpec" in out
+    assert "train-step FLOPs" in out and "G/sample" in out
+    assert not (tmp_path / "mnist_mlp" / "metrics.jsonl").exists()
