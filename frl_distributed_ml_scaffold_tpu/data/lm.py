@@ -137,11 +137,10 @@ class TokenBinLM:
     def batch(self, step: int, batch_size: int, host_offset: int = 0) -> dict:
         if self._fallback is not None:
             return self._fallback.batch(step, batch_size, host_offset)
+        from frl_distributed_ml_scaffold_tpu.data import native
+
         cfg = self.cfg
         window = cfg.seq_len + 1  # input + next-token target share it
         rng = np.random.default_rng((self._seed, step, host_offset))
         starts = rng.integers(0, len(self._mm) - window, size=batch_size)
-        toks = np.empty((batch_size, window), np.int32)
-        for i, s in enumerate(starts):
-            toks[i] = self._mm[s : s + window]
-        return {"tokens": toks}
+        return {"tokens": native.gather_windows(self._mm, starts, window)}

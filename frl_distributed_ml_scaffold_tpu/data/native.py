@@ -99,21 +99,48 @@ def _load() -> ctypes.CDLL | None:
         except OSError as e:
             get_logger().warning("native data core load failed (%s)", e)
             return None
-        f64 = ctypes.POINTER(ctypes.c_float)
-        i64 = ctypes.POINTER(ctypes.c_int64)
-        u8 = ctypes.POINTER(ctypes.c_uint8)
-        lib.frl_gather_rows.argtypes = [f64, i64, f64, ctypes.c_int64,
-                                        ctypes.c_int64]
-        lib.frl_gather_rows_u8.argtypes = [u8, i64, f64, ctypes.c_int64,
-                                           ctypes.c_int64]
-        lib.frl_augment_batch.argtypes = [
-            f64, f64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
-            ctypes.c_int64, ctypes.c_int64, ctypes.c_uint64, ctypes.c_int,
-            f64, f64,
-        ]
-        lib.frl_version.restype = ctypes.c_int
+        try:
+            lib.frl_version.restype = ctypes.c_int
+            version = lib.frl_version()
+            if version < 3:
+                # A prebuilt .so shipped without source (trusted above, no
+                # mtime to compare) can predate newer entry points; binding
+                # them would raise mid-training. Degrade, don't crash.
+                get_logger().warning(
+                    "native data core is v%d (< v3, missing gather_windows);"
+                    " using numpy fallback — rebuild from frl_data.cpp",
+                    version,
+                )
+                return None
+            f64 = ctypes.POINTER(ctypes.c_float)
+            i64 = ctypes.POINTER(ctypes.c_int64)
+            u8 = ctypes.POINTER(ctypes.c_uint8)
+            lib.frl_gather_rows.argtypes = [f64, i64, f64, ctypes.c_int64,
+                                            ctypes.c_int64]
+            lib.frl_gather_rows_u8.argtypes = [u8, i64, f64, ctypes.c_int64,
+                                               ctypes.c_int64]
+            lib.frl_augment_batch.argtypes = [
+                f64, f64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_uint64, ctypes.c_int,
+                f64, f64,
+            ]
+            i32 = ctypes.POINTER(ctypes.c_int32)
+            u16 = ctypes.POINTER(ctypes.c_uint16)
+            u32 = ctypes.POINTER(ctypes.c_uint32)
+            lib.frl_gather_windows_u16.argtypes = [
+                u16, i64, i32, ctypes.c_int64, ctypes.c_int64
+            ]
+            lib.frl_gather_windows_u32.argtypes = [
+                u32, i64, i32, ctypes.c_int64, ctypes.c_int64
+            ]
+        except AttributeError as e:
+            get_logger().warning(
+                "native data core missing symbols (%s); using numpy fallback",
+                e,
+            )
+            return None
         _lib = lib
-        get_logger().info("native data core loaded (v%d)", lib.frl_version())
+        get_logger().info("native data core loaded (v%d)", version)
         return _lib
 
 
@@ -161,6 +188,45 @@ def gather_rows(src: np.ndarray, idx: np.ndarray) -> np.ndarray:
         )
     else:
         lib.frl_gather_rows(_fptr(src), iptr, _fptr(out), len(idx), row)
+    return out
+
+
+def gather_windows(src: np.ndarray, starts: np.ndarray, window: int) -> np.ndarray:
+    """dst[i] = src[starts[i] : starts[i] + window] as int32.
+
+    The LM token-bin read path: ``src`` is a 1-D uint16/uint32 memmap;
+    windows start at arbitrary offsets (plain row-gather can't express
+    this). Native path parallelizes the page-faulting copies; the numpy
+    fallback is bit-identical.
+    """
+    starts = np.ascontiguousarray(starts, dtype=np.int64)
+    if starts.size and (
+        starts.min() < 0 or starts.max() + window > len(src)
+    ):
+        bad = starts[(starts < 0) | (starts + window > len(src))][0]
+        raise IndexError(
+            f"gather_windows start {bad} (+{window}) out of bounds for "
+            f"{len(src)} tokens"
+        )
+    lib = _load()
+    fname = {
+        np.dtype(np.uint16): "frl_gather_windows_u16",
+        np.dtype(np.uint32): "frl_gather_windows_u32",
+    }.get(src.dtype)
+    if lib is None or fname is None or not src.flags["C_CONTIGUOUS"]:
+        out = np.empty((len(starts), window), np.int32)
+        for i, s in enumerate(starts):
+            out[i] = src[s : s + window]
+        return out
+    out = np.empty((len(starts), window), np.int32)
+    ptr_t = ctypes.c_uint16 if src.dtype == np.uint16 else ctypes.c_uint32
+    getattr(lib, fname)(
+        src.ctypes.data_as(ctypes.POINTER(ptr_t)),
+        starts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        len(starts),
+        window,
+    )
     return out
 
 

@@ -174,6 +174,29 @@ void frl_gather_rows_u8(const uint8_t* src, const int64_t* idx, float* dst,
   });
 }
 
+// Windowed gather from a flat token stream (the LM corpus path): each
+// output row is window tokens starting at starts[i], widened to int32.
+// Arbitrary (unaligned) starts — this is the piece plain row-gather can't
+// express; the per-window copy is where the token-bin mmap page faults
+// happen, across the pool.
+void frl_gather_windows_u16(const uint16_t* src, const int64_t* starts,
+                            int32_t* dst, int64_t n, int64_t window) {
+  Pool::instance().parallel_for(n, [&](int64_t i) {
+    const uint16_t* s = src + starts[i];
+    int32_t* d = dst + i * window;
+    for (int64_t e = 0; e < window; ++e) d[e] = (int32_t)s[e];
+  });
+}
+
+void frl_gather_windows_u32(const uint32_t* src, const int64_t* starts,
+                            int32_t* dst, int64_t n, int64_t window) {
+  Pool::instance().parallel_for(n, [&](int64_t i) {
+    const uint32_t* s = src + starts[i];
+    int32_t* d = dst + i * window;
+    for (int64_t e = 0; e < window; ++e) d[e] = (int32_t)s[e];
+  });
+}
+
 // Train-time augmentation on NHWC float32: per-sample random crop from
 // (h, w) to (crop, crop) + horizontal flip (p=0.5) + per-channel
 // normalize. Eval: center crop, no flip. One pass over the bytes.
@@ -213,6 +236,6 @@ void frl_augment_batch(const float* in, float* out, int64_t n, int64_t h,
   });
 }
 
-int frl_version() { return 2; }
+int frl_version() { return 3; }
 
 }  // extern "C"

@@ -23,8 +23,26 @@ _LOGGERS: dict[str, logging.Logger] = {}
 
 
 def is_primary_process() -> bool:
-    """True on the process that should write logs (reference: rank 0)."""
-    return jax.process_index() == 0
+    """True on the process that should write logs (reference: rank 0).
+
+    Deliberately does NOT call ``jax.process_index()``: that initializes
+    the device backend, so a host-side code path that merely wants to log
+    (the native data core loader, offline tools) would block forever when
+    the TPU relay is unreachable. The distributed runtime's process id is
+    readable without touching any backend; when ``jax.distributed`` was
+    never initialized this is a single-controller process and it is
+    primary by definition (the launcher always initializes distributed for
+    multi-process runs).
+    """
+    try:
+        from jax._src import distributed
+
+        pid = getattr(distributed.global_state, "process_id", None)
+        if pid is not None:
+            return pid == 0
+    except Exception:  # private-API drift: fall through to primary
+        pass
+    return True
 
 
 def get_logger(name: str = "frl_tpu") -> logging.Logger:

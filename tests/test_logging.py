@@ -1,0 +1,28 @@
+"""Logging must stay backend-free: a host-side code path that merely wants
+a logger (native core loader, offline tools) must never trigger device
+bring-up — on an unreachable TPU relay that blocks forever (observed)."""
+
+import os
+import subprocess
+import sys
+
+
+def test_is_primary_process_initializes_no_backend():
+    code = (
+        "import sys; sys.path.insert(0, '.')\n"
+        "from frl_distributed_ml_scaffold_tpu.utils.logging import (\n"
+        "    get_logger, is_primary_process)\n"
+        "assert is_primary_process() is True\n"
+        "get_logger().info('hello')\n"
+        "from jax._src import xla_bridge\n"
+        "assert not xla_bridge._backends, xla_bridge._backends\n"
+        "print('NO_BACKEND_OK')\n"
+    )
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}  # harmless if it DID init
+    r = subprocess.run(
+        [sys.executable, "-c", code], cwd=repo, env=env,
+        capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "NO_BACKEND_OK" in r.stdout

@@ -36,6 +36,34 @@ def test_gather_rows_uint8_scales():
                                rtol=1e-6)
 
 
+@pytest.mark.parametrize("dtype", [np.uint16, np.uint32])
+def test_gather_windows_matches_slices(dtype):
+    src = np.random.default_rng(1).integers(0, 60000, size=4096).astype(dtype)
+    starts = np.array([0, 17, 4096 - 33, 1000, 17], np.int64)
+    out = nv.gather_windows(src, starts, 33)
+    assert out.dtype == np.int32
+    for row, s in zip(out, starts):
+        np.testing.assert_array_equal(row, src[s : s + 33].astype(np.int32))
+
+
+def test_gather_windows_bounds_checked():
+    src = np.zeros(100, np.uint16)
+    with pytest.raises(IndexError):
+        nv.gather_windows(src, np.array([90], np.int64), 11)
+    with pytest.raises(IndexError):
+        nv.gather_windows(src, np.array([-1], np.int64), 5)
+
+
+@requires_native
+def test_gather_windows_native_matches_fallback(monkeypatch):
+    src = np.random.default_rng(2).integers(0, 2**16, size=8192).astype(np.uint16)
+    starts = np.random.default_rng(3).integers(0, 8192 - 65, size=64)
+    native_out = nv.gather_windows(src, starts, 65)
+    monkeypatch.setattr(nv, "_load", lambda: None)
+    fallback_out = nv.gather_windows(src, starts, 65)
+    np.testing.assert_array_equal(native_out, fallback_out)
+
+
 def test_pool_stress_back_to_back_calls():
     """Race regression: rapid back-to-back parallel_for calls (the
     gather-then-augment pattern) must neither corrupt results nor hang."""
