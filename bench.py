@@ -62,8 +62,13 @@ def _progress(msg: str) -> None:
 
 def bench_config(name: str, overrides: list[str], *, steps: int, warmup: int):
     from frl_distributed_ml_scaffold_tpu.config import apply_overrides, get_config
+    from frl_distributed_ml_scaffold_tpu.launcher.launch import enable_compile_cache
     from frl_distributed_ml_scaffold_tpu.trainer.loop import Trainer
     from frl_distributed_ml_scaffold_tpu.utils.timing import StepTimer
+
+    # Repeat bench runs of the same config hit the persistent compile cache
+    # instead of paying the 20-40s TPU compile inside the watchdog budget.
+    enable_compile_cache()
 
     # prefetch=0: the benchmark reuses one device-resident batch; background
     # prefetch would only add host/device contention inside timed windows.

@@ -102,10 +102,18 @@ def _load() -> ctypes.CDLL | None:
         try:
             lib.frl_version.restype = ctypes.c_int
             version = lib.frl_version()
+            if version < 3 and os.path.exists(_SRC):
+                # Stale binary the mtime check missed (checkout ordering,
+                # clock skew) but the source is right here — rebuild once.
+                del lib
+                if _build():
+                    lib = ctypes.CDLL(_LIB)
+                    lib.frl_version.restype = ctypes.c_int
+                    version = lib.frl_version()
             if version < 3:
-                # A prebuilt .so shipped without source (trusted above, no
-                # mtime to compare) can predate newer entry points; binding
-                # them would raise mid-training. Degrade, don't crash.
+                # A prebuilt .so shipped without source can predate newer
+                # entry points; binding them would raise mid-training.
+                # Degrade, don't crash.
                 get_logger().warning(
                     "native data core is v%d (< v3, missing gather_windows);"
                     " using numpy fallback — rebuild from frl_data.cpp",

@@ -91,6 +91,28 @@ def _configure_platform(args) -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    enable_compile_cache()
+
+
+def enable_compile_cache(cache_dir: str | None = None) -> None:
+    """Persistent XLA compilation cache (shared by launcher, bench, tests).
+
+    First TPU compiles run ~20-40s; repeat runs of the same config hit the
+    cache instead. Off only when FRL_TPU_NO_COMPILE_CACHE is set; cache
+    write failures are non-fatal inside jax.
+    """
+    if os.environ.get("FRL_TPU_NO_COMPILE_CACHE"):
+        return
+    import jax
+
+    if cache_dir is None:
+        cache_dir = os.environ.get(
+            "FRL_TPU_COMPILE_CACHE",
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))), ".jax_cache"),
+        )
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 
 def run_experiment(cfg, *, check_imports: bool = True):

@@ -25,14 +25,23 @@ _LOGGERS: dict[str, logging.Logger] = {}
 def is_primary_process() -> bool:
     """True on the process that should write logs (reference: rank 0).
 
-    Deliberately does NOT call ``jax.process_index()``: that initializes
-    the device backend, so a host-side code path that merely wants to log
-    (the native data core loader, offline tools) would block forever when
-    the TPU relay is unreachable. The distributed runtime's process id is
-    readable without touching any backend; when ``jax.distributed`` was
-    never initialized this is a single-controller process and it is
-    primary by definition (the launcher always initializes distributed for
-    multi-process runs).
+    Deliberately never *initializes* a backend: a host-side code path that
+    merely wants to log (the native data core loader, offline tools) would
+    block forever on an unreachable TPU relay if this called
+    ``jax.process_index()`` cold. Resolution order:
+
+    1. the distributed runtime's process id (backend-free; set whenever
+       ``jax.distributed.initialize`` ran — the launcher's multi-process
+       path);
+    2. ``jax.process_index()`` — but only when a backend already exists,
+       so the call cannot trigger bring-up (covers multi-host stacks that
+       know their rank from topology without explicit distributed init);
+    3. primary — no distributed runtime and no backend means there is
+       nobody else to defer to.
+
+    Residual caveat: on path-3 hosts that later become non-primary, early
+    log lines (before backend init) may appear on every host — cosmetic,
+    and strictly better than the hang.
     """
     try:
         from jax._src import distributed
@@ -40,7 +49,14 @@ def is_primary_process() -> bool:
         pid = getattr(distributed.global_state, "process_id", None)
         if pid is not None:
             return pid == 0
-    except Exception:  # private-API drift: fall through to primary
+    except Exception:  # private-API drift: fall through
+        pass
+    try:
+        from jax._src import xla_bridge
+
+        if getattr(xla_bridge, "_backends", None):
+            return jax.process_index() == 0
+    except Exception:
         pass
     return True
 

@@ -31,11 +31,13 @@ jax.config.update("jax_platforms", "cpu")
 # Persistent compilation cache (repo-local, gitignored): the suite's wall
 # time is dominated by XLA compiles of the same tiny models on the same
 # 8-device mesh; caching them across runs cuts repeat `pytest` runs by
-# minutes on this 1-core box. Fresh checkouts just pay the one-time fill.
-_cache_dir = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"
+# minutes on this 1-core box. One shared helper with the launcher/bench;
+# tests lower the thresholds because their compiles are tiny but numerous.
+from frl_distributed_ml_scaffold_tpu.launcher.launch import (  # noqa: E402
+    enable_compile_cache,
 )
-jax.config.update("jax_compilation_cache_dir", _cache_dir)
+
+enable_compile_cache()
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 

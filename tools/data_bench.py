@@ -22,7 +22,7 @@ import numpy as np
 # Host-only tool: never bring up an accelerator backend (the axon relay can
 # hang indefinitely when unreachable, and nothing here needs a device).
 os.environ["JAX_PLATFORMS"] = "cpu"
-sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from frl_distributed_ml_scaffold_tpu.config.schema import DataConfig  # noqa: E402
 from frl_distributed_ml_scaffold_tpu.data import native  # noqa: E402
