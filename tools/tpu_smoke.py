@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""On-chip smoke tier (SURVEY §4): the checks only real hardware can give.
+
+CI runs everything on the simulated CPU mesh; the one check it structurally
+cannot perform is "the Pallas kernels Mosaic actually compiles produce the
+same numbers as the reference math". This tool runs that plus a short
+learn-check on the real chip, one JSONL line per check, everything bounded
+(the relay can hang — callers should wrap with `timeout`).
+
+    timeout 900 python tools/tpu_smoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def emit(check: str, ok: bool, **extra) -> None:
+    print(json.dumps({"check": check, "ok": bool(ok), **extra}), flush=True)
+
+
+def main() -> int:
+    import jax
+
+    # The axon sitecustomize pins jax_platforms at the config level, which
+    # beats env vars — re-assert JAX_PLATFORMS so e.g. a CPU dry run works.
+    p = os.environ.get("JAX_PLATFORMS")
+    if p:
+        jax.config.update("jax_platforms", p)
+
+    t0 = time.time()
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", str(dev))
+    emit("backend_up", True, device=kind, seconds=round(time.time() - t0, 1))
+    if jax.default_backend() != "tpu":
+        emit("is_tpu", False, backend=jax.default_backend())
+        return 1
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from frl_distributed_ml_scaffold_tpu.ops.flash_attention import flash_attention
+    from frl_distributed_ml_scaffold_tpu.ops.ring_attention import dense_attention
+
+    failures = 0
+
+    # --- Pallas flash kernel, REAL Mosaic compile, vs dense reference ----
+    for dtype, tol in ((jnp.float32, 2e-5), (jnp.bfloat16, 3e-2)):
+        for causal in (True, False):
+            ks = jax.random.split(jax.random.key(0), 3)
+            q, k, v = (jax.random.normal(kk, (2, 512, 4, 64), dtype) for kk in ks)
+            t0 = time.time()
+            out = jax.jit(
+                lambda q, k, v: flash_attention(q, k, v, causal=causal)
+            )(q, k, v)
+            ref = dense_attention(q, k, v, causal=causal)
+            err = float(
+                jnp.max(jnp.abs(out.astype(jnp.float32) - ref.astype(jnp.float32)))
+            )
+            ok = err < tol
+            failures += not ok
+            emit(
+                f"flash_fwd_{np.dtype(dtype).name}_causal{int(causal)}",
+                ok, max_abs_err=err, seconds=round(time.time() - t0, 1),
+            )
+
+    # Gradients through the real backward kernels.
+    ks = jax.random.split(jax.random.key(7), 3)
+    q, k, v = (jax.random.normal(kk, (1, 256, 2, 64), jnp.float32) for kk in ks)
+
+    def loss(att):
+        return jax.jit(
+            jax.grad(
+                lambda q, k, v: (att(q, k, v) * jnp.cos(
+                    jnp.arange(q.size, dtype=jnp.float32).reshape(q.shape)
+                )).sum(),
+                argnums=(0, 1, 2),
+            )
+        )
+
+    g_flash = loss(lambda q, k, v: flash_attention(q, k, v, causal=True))(q, k, v)
+    g_dense = loss(lambda q, k, v: dense_attention(q, k, v, causal=True))(q, k, v)
+    for gf, gd, name in zip(g_flash, g_dense, "qkv"):
+        err = float(jnp.max(jnp.abs(gf - gd)))
+        ok = err < 5e-4
+        failures += not ok
+        emit(f"flash_grad_d{name}", ok, max_abs_err=err)
+
+    # --- short real-chip learn check (BASELINE config 1) -----------------
+    from frl_distributed_ml_scaffold_tpu.config import apply_overrides, get_config
+    from frl_distributed_ml_scaffold_tpu.trainer.loop import Trainer
+
+    cfg = apply_overrides(
+        get_config("mnist_mlp"),
+        ["data.global_batch_size=256", "data.prefetch=0",
+         "trainer.log_every=1000000", "checkpoint.enabled=false",
+         "workdir=/tmp/frl_tpu_smoke"],
+    )
+    trainer = Trainer(cfg)
+    state = trainer.init_state()
+    batch = trainer.pipeline.global_batch(0)
+    losses = []
+    for step in range(30):
+        state, metrics = trainer.train_step(state, batch)
+        if step % 10 == 0 or step == 29:
+            losses.append(float(jax.device_get(metrics["loss"])))
+    ok = losses[-1] < losses[0] and np.isfinite(losses).all()
+    failures += not ok
+    emit("mnist_learns_on_chip", ok, losses=[round(l, 4) for l in losses])
+
+    emit("summary", failures == 0, failures=failures)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
