@@ -106,14 +106,21 @@ def build_mesh(cfg: MeshConfig, devices=None) -> MeshEnv:
             sizes[a] // cfg.dcn_data if a == "data" else sizes[a] for a in AXES
         )
         dcn_shape = tuple(cfg.dcn_data if a == "data" else 1 for a in AXES)
-        # Gate the fallback on MISSING SLICE METADATA only (CPU simulation):
-        # on real multi-slice hardware a create_hybrid_device_mesh error is
-        # an actionable misconfiguration and must propagate, not silently
-        # degrade to a hand-rolled layout that may straddle DCN.
-        has_slice_meta = all(
-            getattr(d, "slice_index", None) is not None for d in devices
-        )
-        if has_slice_meta:
+        # Routing: CPU simulation (incl. multi-process CPU, whose devices
+        # carry a nominal slice 0) takes the manual layout below. On real
+        # accelerators the slice metadata must MATCH the config — a
+        # dcn_data that disagrees with the physical slice count is an
+        # actionable misconfiguration and must raise, not silently degrade
+        # to a hand-rolled layout that would straddle DCN.
+        is_sim = all(getattr(d, "platform", None) == "cpu" for d in devices)
+        slice_ids = {getattr(d, "slice_index", None) for d in devices}
+        real_slices = {s for s in slice_ids if s is not None}
+        if not is_sim and real_slices and len(real_slices) != cfg.dcn_data:
+            raise ValueError(
+                f"mesh.dcn_data={cfg.dcn_data} but the device topology "
+                f"reports {len(real_slices)} slice(s)"
+            )
+        if not is_sim and len(real_slices) > 1:
             dev_array = mesh_utils.create_hybrid_device_mesh(
                 ici_shape, dcn_shape, devices=devices
             )

@@ -66,6 +66,22 @@ def main() -> int:
         state, metrics = trainer.train_step(state, batch)
     # The loss is a global reduction — every process must report the same.
     out["loss"] = round(float(jax.device_get(metrics["loss"])), 6)
+
+    # Hybrid ICI x DCN mesh across REAL process boundaries: with 2
+    # processes x 4 local devices, dcn_data=2 puts the slice boundary
+    # exactly at the process boundary — the closest a test can get to a
+    # multi-slice pod without pod hardware.
+    cfg_dcn = apply_overrides(
+        cfg, ["mesh.dcn_data=2", "workdir=" + os.environ["FRL_TEST_WORKDIR"] + "/dcn"]
+    )
+    t2 = Trainer(cfg_dcn)
+    out["dcn_mesh"] = dict(t2.env.mesh.shape)
+    s2 = t2.init_state()
+    for step in range(2):
+        b2 = t2.pipeline.global_batch(step)
+        s2, m2 = t2.train_step(s2, b2)
+    out["dcn_loss"] = round(float(jax.device_get(m2["loss"])), 6)
+
     print("CHECK " + json.dumps(out), flush=True)
     shutdown_distributed()
     return 0

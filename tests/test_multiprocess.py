@@ -9,6 +9,7 @@ import socket
 import subprocess
 import sys
 
+import numpy as np
 import pytest
 
 
@@ -70,3 +71,8 @@ def test_two_process_init_collectives_and_train(tmp_path):
         assert c["local_batch"] == 8  # 16 global over 2 processes
     # The global loss reduction must agree across processes exactly.
     assert by_pid[0]["loss"] == by_pid[1]["loss"]
+    # Hybrid ICI x DCN mesh across the real process boundary trains too.
+    for c in checks:
+        assert c["dcn_mesh"]["data"] == 8
+        assert np.isfinite(c["dcn_loss"])
+    assert by_pid[0]["dcn_loss"] == by_pid[1]["dcn_loss"]
