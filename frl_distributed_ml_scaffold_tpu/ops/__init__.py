@@ -3,13 +3,17 @@
 Manual-parallelism attention implementations that GSPMD cannot derive from
 sharding annotations alone:
 
-- ``ring_attention`` — blockwise-softmax attention with the KV shards
-  rotating around the ``seq`` mesh axis via ``ppermute`` (ring/blockwise
+- ``ring_attention`` — KV shards rotate around the ``seq`` mesh axis via
+  ``ppermute``; each hop runs the fused flash kernel with logsumexp
+  merging, and a custom VJP re-rotates KV in the backward (ring/blockwise
   attention; PAPERS.md collective-redistribution lineage).
 - ``ulysses_attention`` — DeepSpeed-Ulysses-style ``all_to_all`` reshard
-  (seq-sharded ↔ head-sharded) around ordinary dense attention.
+  (seq-sharded ↔ head-sharded) around flash attention on the local
+  full-length sequence.
 - ``flash_attention`` — the fused Pallas TPU kernel (online-softmax fwd +
-  two-kernel custom-VJP bwd); the framework's hand-written "native" tier.
+  two-kernel custom-VJP bwd); the framework's hand-written "native" tier
+  and the building block of both sharded modes above. Under a
+  sequence-sharded mesh it delegates to ``ring_attention``.
 - ``dense_attention`` — the single-device reference all sharded paths
   reduce to; fp32 softmax, bf16-multiply/fp32-accumulate einsums.
 
