@@ -235,6 +235,11 @@ class GPTConfig:
     # stages" (the minimum that keeps every stage busy outside the bubble).
     pipeline_stages: int = 1
     pipeline_microbatches: int = 0
+    # Circular (interleaved) schedule: each physical stage holds this many
+    # non-adjacent layer groups ("virtual stages"), cutting the GPipe bubble
+    # from (S-1)/(M+S-1) to (S-1)/(repeat*M + S-1) at the price of rotating
+    # activations through the stages ``repeat`` times. 1 = plain GPipe.
+    pipeline_circular_repeat: int = 1
 
 
 @dataclass(frozen=True)

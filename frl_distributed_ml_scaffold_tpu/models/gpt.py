@@ -32,12 +32,18 @@ from frl_distributed_ml_scaffold_tpu.parallel.partition import PartitionRules
 from frl_distributed_ml_scaffold_tpu.precision import Policy
 
 
-def gpt_tp_rules(pipelined: bool = False) -> PartitionRules:
+def gpt_tp_rules(pipelined: bool = False, circular: bool = False) -> PartitionRules:
     """Megatron column/row sharding (SURVEY C6). Kernels carry a leading
     layer dim from nn.scan stacking, hence the extra ``None``; under
     pipeline parallelism they carry ``[stage, layer_in_stage, ...]`` and the
-    stage dim shards over ``pipe`` (SURVEY C7)."""
-    pre: tuple = ("pipe", None) if pipelined else (None,)
+    stage dim shards over ``pipe`` (SURVEY C7). The circular schedule adds a
+    leading virtual-repeat dim: ``[repeat, stage, layer_in_group, ...]``."""
+    if circular:
+        pre: tuple = (None, "pipe", None)
+    elif pipelined:
+        pre = ("pipe", None)
+    else:
+        pre = (None,)
     rules: tuple = (
         (r"blocks/attn/(query|key|value)/kernel", P(*pre, None, "model")),
         (r"blocks/attn/(query|key|value)/bias", P(*pre, "model")),
@@ -50,9 +56,11 @@ def gpt_tp_rules(pipelined: bool = False) -> PartitionRules:
         (r"blocks/moe/router/kernel", P(*pre, None, None)),
         (r"wte/embedding", P("model", None)),
     )
-    if pipelined:
+    if circular:
         # Everything else inside the stacked blocks (LayerNorm scales etc.)
         # still lives on its stage. Placed last — first match wins.
+        rules = rules + ((r"blocks/", P(None, "pipe")),)
+    elif pipelined:
         rules = rules + ((r"blocks/", P("pipe")),)
     return PartitionRules(rules=rules)
 
@@ -173,17 +181,22 @@ class GPT(nn.Module):
             # so those regions batch over the stage dim and compose — no
             # mode exclusions.
             from frl_distributed_ml_scaffold_tpu.parallel.pipeline import (
+                CircularSpmdPipeline,
                 SpmdPipeline,
+                circular_repeat,
                 effective_microbatches,
             )
 
-            pipe = SpmdPipeline(
+            v = circular_repeat(cfg)
+            cls = CircularSpmdPipeline if v > 1 else SpmdPipeline
+            pipe = cls(
                 Block,
                 (cfg, dtype, train),
                 num_layers=cfg.num_layers,
                 num_stages=cfg.pipeline_stages,
                 num_microbatches=effective_microbatches(cfg),
                 name="pipeline",
+                **({"repeat": v} if v > 1 else {}),
             )
             x, aux_loss = pipe(x, jnp.zeros((), jnp.float32))
         else:

@@ -55,17 +55,26 @@ def effective_microbatches(model_cfg) -> int:
     return getattr(model_cfg, "pipeline_microbatches", 0) or stages
 
 
+def circular_repeat(model_cfg) -> int:
+    """Virtual-stage multiplier of the circular schedule (1 = plain GPipe)."""
+    if getattr(model_cfg, "pipeline_stages", 1) <= 1:
+        return 1
+    return getattr(model_cfg, "pipeline_circular_repeat", 1) or 1
+
+
 def pipeline_summary(model_cfg) -> str | None:
-    """One-line human summary incl. the GPipe bubble fraction, or None when
-    the model isn't pipelined — the single place the formula lives."""
+    """One-line human summary incl. the schedule's bubble fraction, or None
+    when the model isn't pipelined — the single place the formula lives."""
     stages = getattr(model_cfg, "pipeline_stages", 1)
     if stages <= 1:
         return None
     micro = effective_microbatches(model_cfg)
-    bubble = (stages - 1) / (micro + stages - 1)
+    v = circular_repeat(model_cfg)
+    bubble = (stages - 1) / (v * micro + stages - 1)
+    sched = "gpipe" if v == 1 else f"circular(x{v})"
     return (
-        f"pipeline: {stages} stages x {micro} microbatches, "
-        f"bubble fraction (S-1)/(M+S-1) = {bubble:.3f}"
+        f"pipeline: {stages} stages x {micro} microbatches [{sched}], "
+        f"bubble fraction (S-1)/(vM+S-1) = {bubble:.3f}"
     )
 
 
@@ -190,4 +199,190 @@ class SpmdPipeline(nn.Module):
         aux = aux0 + aux_sum / m
         # Microbatch t emerges from the last stage at tick t + S - 1.
         out = ys[s - 1 :].reshape((m * mb,) + ys.shape[2:])
+        return _constrain(out, BATCH_AXES), aux
+
+
+class CircularSpmdPipeline(nn.Module):
+    """Circular (interleaved) pipeline schedule — GPipe's bubble cut by ``v``.
+
+    Each physical stage ``j`` holds ``v`` non-adjacent layer groups
+    ("virtual stages" ``r*S + j`` for ``r in [0, v)``), so every microbatch
+    rotates through the stage ring ``v`` times. The fill/drain bubble
+    amortizes over ``v*M`` busy ticks instead of ``M``:
+    ``(S-1)/(v*M + S-1)`` — the same schedule praxis/Megatron call circular
+    or interleaved pipelining, expressed here entirely inside one GSPMD
+    program (no MPMD ranks, cf. PAPERS.md).
+
+    Mechanics per tick ``t`` of ``v*M + S - 1``:
+
+    - **Param selection.** Block params live as ONE pytree-valued flax param
+      ``blocks`` with leading dims ``[v, S, L/(S*v), ...]`` (stage dim
+      sharded over ``pipe``). Stage ``j`` is working repeat
+      ``r_j = (t - j) // M``, so each tick gathers ``leaf[r_j, j]`` — a
+      per-stage dynamic index on the *unsharded* ``v`` dim, which GSPMD
+      partitions without touching other stages' weights.
+    - **Compute.** The selected per-stage params are applied with
+      ``jax.vmap(stage.apply, spmd_axis_name="pipe")`` — the same batched
+      stage compute as the GPipe class, so flash/ring/ulysses attention
+      (which open shard_map regions) compose identically.
+    - **Rotation + parking.** The ``[S, mb, ...]`` buffer rolls by one
+      (collective-permute over ``pipe``). A microbatch leaving stage S-1
+      mid-run is not finished — it re-enters stage 0 for its next repeat
+      after waiting ``M - S`` ticks in a parking FIFO (for ``M == S`` the
+      roll wraparound IS the re-entry). External inputs feed slot 0 only
+      during the first ``M`` ticks; recirculated activations after that.
+
+    Requires ``M >= S`` (otherwise a re-entering microbatch collides with
+    the injection of a fresh one) and ``num_layers % (S*v) == 0``.
+    """
+
+    block_cls: Any
+    block_args: tuple
+    num_layers: int
+    num_stages: int
+    num_microbatches: int
+    repeat: int
+
+    @nn.compact
+    def __call__(self, x: jax.Array, aux0: jax.Array):
+        s, m, v = self.num_stages, self.num_microbatches, self.repeat
+        if self.num_layers % (s * v):
+            raise ValueError(
+                f"{self.num_layers} layers not divisible by "
+                f"{s} stages x {v} circular repeats"
+            )
+        if x.shape[0] % m:
+            raise ValueError(f"batch {x.shape[0]} not divisible by {m} microbatches")
+        if m < s:
+            raise ValueError(
+                f"circular schedule needs microbatches >= stages ({m} < {s}): "
+                "a re-entering microbatch would collide with a fresh injection"
+            )
+        mb = x.shape[0] // m
+        lg = self.num_layers // (s * v)
+        ticks = v * m + s - 1
+        # Parking FIFO between exit from stage S-1 (pushed at end of tick t)
+        # and re-entry into stage 0 (read at start of tick t + M - S + 1,
+        # i.e. after M - S intervening shifts — hence M - S + 1 slots).
+        qlen = m - s + 1
+
+        # Layers within one virtual-stage group run sequentially, exactly as
+        # the GPipe class's per-stage nn.scan. The module is detached
+        # (parent=None): its params are owned by THIS module as the stacked
+        # ``blocks`` pytree below, and init/apply are used purely.
+        stage = nn.scan(
+            self.block_cls,
+            length=lg,
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "dropout": True},
+        )(*self.block_args, parent=None)
+
+        slot_ex = (
+            jnp.zeros((mb,) + x.shape[1:], x.dtype),
+            jnp.zeros((), jnp.float32),
+        )
+
+        def init_stacked(rng):
+            g = v * s
+            rngs = jax.random.split(rng, g)
+            ps = jax.vmap(
+                lambda r: stage.init({"params": r, "dropout": r}, slot_ex, None)[
+                    "params"
+                ]
+            )(rngs)
+            # [g, lg, ...] -> [v, s, lg, ...]; virtual stage r*S+j -> [r, j].
+            return jax.tree.map(
+                lambda l: l.reshape((v, s) + l.shape[1:]), ps
+            )
+
+        stacked = self.param("blocks", init_stacked)
+
+        has_drop = self.has_rng("dropout")
+        drop_rng = self.make_rng("dropout") if has_drop else None
+
+        def select_params(r_vec):
+            """leaf[v, s, ...] -> [s, ...] with out[j] = leaf[r_vec[j], j]."""
+            env = current_mesh_env()
+
+            def sel(leaf):
+                picked = jax.vmap(
+                    lambda lv, r: jax.lax.dynamic_index_in_dim(
+                        lv, r, axis=0, keepdims=False
+                    ),
+                    in_axes=(1, 0),
+                )(leaf, r_vec)
+                if env is None:
+                    return picked
+                # Pin only the stage dim; trailing dims stay UNCONSTRAINED so
+                # GSPMD keeps e.g. Megatron 'model'-sharded kernels sharded
+                # (a None here would mean "replicated" and force a per-tick
+                # all-gather of every TP weight).
+                spec = P(
+                    "pipe", *([P.UNCONSTRAINED] * (picked.ndim - 1))
+                )
+                return jax.lax.with_sharding_constraint(
+                    picked, NamedSharding(env.mesh, spec)
+                )
+
+            return jax.tree.map(sel, stacked)
+
+        def apply_stage(p, slot, rng):
+            rngs = {"dropout": rng} if has_drop else None
+            (y, aux), _ = stage.apply(
+                {"params": p}, (slot, jnp.zeros((), jnp.float32)), None, rngs=rngs
+            )
+            return y, aux
+
+        vmapped_apply = jax.vmap(apply_stage, spmd_axis_name="pipe")
+
+        x_mb = _constrain(x.reshape((m, mb) + x.shape[1:]), None, BATCH_AXES)
+
+        def tick(carry, t):
+            buf, queue, aux_acc = carry
+            # Injection: external feed while filling (ticks 0..M-1), parked
+            # activations re-entering for their next repeat afterwards. The
+            # clamped index keeps the feed at M slots (its value is ignored
+            # for t >= M) instead of padding v*M+S-1 zero microbatches.
+            inp = x_mb[jnp.minimum(t, m - 1)]
+            recirc = queue[qlen - 1]
+            buf = buf.at[0].set(
+                jnp.where(t < m, inp.astype(buf.dtype), recirc)
+            )
+            buf = _constrain(buf, "pipe", BATCH_AXES)
+
+            offs = t - jnp.arange(s)
+            r_vec = jnp.clip(offs // m, 0, v - 1).astype(jnp.int32)
+            valid = (offs >= 0) & (offs < v * m)
+            params_t = select_params(r_vec)
+            if has_drop:
+                rngs_t = jax.vmap(
+                    lambda j: jax.random.fold_in(jax.random.fold_in(drop_rng, t), j)
+                )(jnp.arange(s))
+            else:
+                rngs_t = jnp.zeros((s,), jnp.uint32)  # unused placeholder
+            out, aux_delta = vmapped_apply(params_t, buf, rngs_t)
+            aux_acc = aux_acc + jnp.sum(aux_delta * valid.astype(jnp.float32))
+            y = out[s - 1]
+            queue = _constrain(
+                jnp.roll(queue, 1, axis=0).at[0].set(y), None, BATCH_AXES
+            )
+            buf_next = _constrain(jnp.roll(out, 1, axis=0), "pipe", BATCH_AXES)
+            return (buf_next, queue, aux_acc), y
+
+        buf0 = _constrain(
+            jnp.zeros((s, mb) + x.shape[1:], x.dtype), "pipe", BATCH_AXES
+        )
+        queue0 = _constrain(
+            jnp.zeros((qlen, mb) + x.shape[1:], x.dtype), None, BATCH_AXES
+        )
+        (_, _, aux_sum), ys = jax.lax.scan(
+            tick,
+            (buf0, queue0, jnp.zeros((), jnp.float32)),
+            jnp.arange(ticks),
+        )
+        # Each microbatch contributes one aux term per virtual stage pass;
+        # normalize to the plain path's per-batch value (cf. SpmdPipeline).
+        aux = aux0 + aux_sum / m
+        # Microbatch t of the final repeat exits at tick (v-1)*M + t + S - 1.
+        out = ys[(v - 1) * m + s - 1 :].reshape((m * mb,) + ys.shape[2:])
         return _constrain(out, BATCH_AXES), aux

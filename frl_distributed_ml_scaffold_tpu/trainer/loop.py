@@ -58,8 +58,11 @@ def model_partition_rules(model_cfg: Any, env: MeshEnv) -> PartitionRules | None
     family = getattr(model_cfg, "family", None)
     if family == "gpt":
         from frl_distributed_ml_scaffold_tpu.models.gpt import gpt_tp_rules
+        from frl_distributed_ml_scaffold_tpu.parallel.pipeline import circular_repeat
 
-        return gpt_tp_rules(pipelined=pipelined)
+        return gpt_tp_rules(
+            pipelined=pipelined, circular=circular_repeat(model_cfg) > 1
+        )
     if family in ("vit", "video"):
         from frl_distributed_ml_scaffold_tpu.models.vit import vit_tp_rules
 
