@@ -1,0 +1,97 @@
+"""Autoregressive generation for the GPT family: prefill + KV-cache decode.
+
+The training scaffold's inference story (call stack (e) in SURVEY.md §3 is
+eval-forward; this extends it to sampling). TPU-idiomatic shape: one
+compiled **prefill** over the whole prompt writes every layer's K/V cache,
+then one compiled **decode step** inside ``lax.scan`` appends a token per
+iteration — static shapes throughout (the cache is pre-sized to
+``config.seq_len``), so the entire generate call is two XLA programs no
+matter how many tokens are produced.
+
+Sampling: greedy (``temperature=0``), temperature, and top-k — all pure
+functions of the passed rng key, so generation is reproducible.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+def _sample(logits: jax.Array, rng, *, temperature: float, top_k: int):
+    """[B, V] logits -> [B] sampled token ids (fp32 for stable softmax)."""
+    logits = logits.astype(jnp.float32)
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k > 0 and top_k < logits.shape[-1]:  # k >= V keeps everything
+        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]  # O(V) threshold
+        logits = jnp.where(logits < kth, jnp.finfo(jnp.float32).min, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def generate(
+    model: Any,
+    params: Any,
+    prompt: jax.Array,
+    *,
+    max_new_tokens: int,
+    temperature: float = 1.0,
+    top_k: int = 0,
+    eos_id: int | None = None,
+    rng: jax.Array | None = None,
+) -> jax.Array:
+    """Sample ``max_new_tokens`` continuations of ``prompt`` ([B, Tp] int).
+
+    Returns [B, Tp + max_new_tokens]; positions after an ``eos_id`` emission
+    (when given) are padded with ``eos_id``. Jit-compatible as long as
+    ``max_new_tokens``/``temperature``/``top_k`` stay static — wrap with
+    ``jax.jit(partial(generate, model, ...), static_argnames=...)`` or just
+    call it; the two inner ``apply`` calls are where the time goes.
+    """
+    cfg = model.config
+    b, tp = prompt.shape
+    if tp + max_new_tokens > cfg.seq_len:
+        raise ValueError(
+            f"prompt ({tp}) + max_new_tokens ({max_new_tokens}) exceeds the "
+            f"model context ({cfg.seq_len}) — the KV cache is sized to it"
+        )
+    rng = jax.random.key(0) if rng is None else rng
+    prompt = prompt.astype(jnp.int32)
+
+    # Prefill: one pass over the prompt creates + fills every layer's cache
+    # (flax creates the 'cache' collection lazily because it is mutable).
+    logits, vars_out = model.apply(
+        {"params": params}, prompt, decode=True, mutable=["cache"]
+    )
+    if isinstance(logits, tuple):  # MoE models also return the aux loss
+        logits = logits[0]
+    cache = vars_out["cache"]
+    rng, sub = jax.random.split(rng)
+    tok = _sample(logits[:, -1], sub, temperature=temperature, top_k=top_k)
+    done = jnp.zeros((b,), bool) if eos_id is None else tok == eos_id
+
+    def step(carry, _):
+        cache, tok, done, rng = carry
+        logits, vars_out = model.apply(
+            {"params": params, "cache": cache},
+            tok[:, None],
+            decode=True,
+            mutable=["cache"],
+        )
+        if isinstance(logits, tuple):
+            logits = logits[0]
+        rng, sub = jax.random.split(rng)
+        nxt = _sample(logits[:, 0], sub, temperature=temperature, top_k=top_k)
+        if eos_id is not None:
+            nxt = jnp.where(done, eos_id, nxt)
+            done = done | (nxt == eos_id)
+        return (vars_out["cache"], nxt, done, rng), tok
+
+    (_, last, _, _), toks = jax.lax.scan(
+        step, (cache, tok, done, rng), None, length=max_new_tokens - 1
+    ) if max_new_tokens > 1 else ((cache, tok, done, rng), jnp.zeros((0, b), jnp.int32))
+    new = jnp.concatenate([toks.T, last[:, None]], axis=1)  # [B, max_new]
+    return jnp.concatenate([prompt, new], axis=1)

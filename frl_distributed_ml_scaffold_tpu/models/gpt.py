@@ -24,6 +24,7 @@ from __future__ import annotations
 from typing import Any
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
@@ -65,12 +66,35 @@ def gpt_tp_rules(pipelined: bool = False, circular: bool = False) -> PartitionRu
     return PartitionRules(rules=rules)
 
 
+def _masked_dense_attention(q, k, v, mask):
+    """Dense attention with an explicit [Tq, Tk] mask, fp32 softmax — the
+    same numerics as ops.dense_attention, used by the KV-cache decode path
+    where causality is against *absolute* positions in the cache, not
+    positions within the (length-1) query window."""
+    hd = q.shape[-1]
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    )
+    scores = scores / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+    scores = jnp.where(mask[None, None], scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd",
+        probs.astype(q.dtype),
+        v,
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(q.dtype)
+
+
 class CausalSelfAttention(nn.Module):
     config: GPTConfig
     dtype: Any
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray, *, train: bool) -> jnp.ndarray:
+    def __call__(
+        self, x: jnp.ndarray, *, train: bool, decode: bool = False
+    ) -> jnp.ndarray:
         cfg = self.config
         d = cfg.hidden_dim
         h = cfg.num_heads
@@ -83,7 +107,36 @@ class CausalSelfAttention(nn.Module):
         k = k.reshape(b, t, h, hd)
         v = v.reshape(b, t, h, hd)
 
-        if cfg.attention == "ring":
+        if decode:
+            # Incremental decoding: append this call's K/V at the absolute
+            # write position and attend over the whole cache. The flash/
+            # ring/ulysses training kernels are pointless at decode shapes
+            # (q is one token), so every attention mode shares this path.
+            s = cfg.seq_len
+            # Cache vars are created lazily on first use: flax permits
+            # variable creation during apply when the collection is mutable.
+            ck = self.variable(
+                "cache", "cached_key", jnp.zeros, (b, s, h, hd), self.dtype
+            )
+            cv = self.variable(
+                "cache", "cached_value", jnp.zeros, (b, s, h, hd), self.dtype
+            )
+            ci = self.variable(
+                "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+            )
+            idx = ci.value
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k.astype(self.dtype), (0, idx, 0, 0)
+            )
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v.astype(self.dtype), (0, idx, 0, 0)
+            )
+            qpos = idx + jnp.arange(t)
+            kpos = jnp.arange(s)
+            mask = kpos[None, :] <= qpos[:, None]  # [t, S]; empty slots are future
+            y = _masked_dense_attention(q, ck.value, cv.value, mask)
+            ci.value = idx + t
+        elif cfg.attention == "ring":
             from frl_distributed_ml_scaffold_tpu.ops.ring_attention import (
                 ring_attention,
             )
@@ -133,13 +186,16 @@ class Block(nn.Module):
     config: GPTConfig
     dtype: Any
     train: bool  # static per-trace; bound at GPT.__call__ construction time
+    decode: bool = False  # KV-cache incremental decoding
 
     @nn.compact
     def __call__(self, carry, _unused):
         x, aux_loss = carry
         cfg, train = self.config, self.train
         y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
-        x = x + CausalSelfAttention(cfg, self.dtype, name="attn")(y, train=train)
+        x = x + CausalSelfAttention(cfg, self.dtype, name="attn")(
+            y, train=train, decode=self.decode
+        )
         y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
         if cfg.moe.num_experts > 0:
             from frl_distributed_ml_scaffold_tpu.models.moe import MoEMlp
@@ -157,7 +213,9 @@ class GPT(nn.Module):
     policy: Policy
 
     @nn.compact
-    def __call__(self, tokens: jnp.ndarray, *, train: bool = False):
+    def __call__(
+        self, tokens: jnp.ndarray, *, train: bool = False, decode: bool = False
+    ):
         cfg = self.config
         dtype = self.policy.compute_dtype
         b, t = tokens.shape
@@ -172,9 +230,27 @@ class GPT(nn.Module):
         wpe = self.param(
             "wpe", nn.initializers.normal(stddev=0.02), (cfg.seq_len, cfg.hidden_dim)
         )
-        x = wte(tokens) + wpe[:t].astype(dtype)
+        if decode:
+            # Positions are absolute: offset by how much of the cache this
+            # call's tokens come after (tracked here so the embedding and
+            # the per-layer attention caches advance together).
+            pos = self.variable(
+                "cache", "pos_index", lambda: jnp.zeros((), jnp.int32)
+            )
+            offset = pos.value
+            pe = jax.lax.dynamic_slice(wpe, (offset, 0), (t, cfg.hidden_dim))
+            pos.value = offset + t
+        else:
+            pe = wpe[:t]
+        x = wte(tokens) + pe.astype(dtype)
         x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
 
+        if decode and cfg.pipeline_stages > 1:
+            raise NotImplementedError(
+                "KV-cache decoding runs on the plain layer stack; set "
+                "pipeline_stages=1 for generation (pipeline parallelism is "
+                "a training-throughput schedule)"
+            )
         if cfg.pipeline_stages > 1:
             # flash/ring/ulysses open their own shard_map regions; the
             # pipeline's stage vmap names its axis (spmd_axis_name="pipe"),
@@ -203,9 +279,9 @@ class GPT(nn.Module):
             blocks = nn.scan(
                 Block,
                 length=cfg.num_layers,
-                variable_axes={"params": 0},
+                variable_axes={"params": 0, "cache": 0},
                 split_rngs={"params": True, "dropout": True},
-            )(cfg, dtype, train, name="blocks")
+            )(cfg, dtype, train, decode, name="blocks")
             (x, aux_loss), _ = blocks((x, jnp.zeros((), jnp.float32)), None)
 
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
