@@ -21,13 +21,14 @@ from frl_distributed_ml_scaffold_tpu.config.schema import ResNetConfig
 from frl_distributed_ml_scaffold_tpu.precision import Policy
 
 STAGE_SIZES = {
+    10: (1, 1, 1, 1),  # ResNet-10: the minimal smoke/test depth
     18: (2, 2, 2, 2),
     34: (3, 4, 6, 3),
     50: (3, 4, 6, 3),
     101: (3, 4, 23, 3),
     152: (3, 8, 36, 3),
 }
-BOTTLENECK = {18: False, 34: False, 50: True, 101: True, 152: True}
+BOTTLENECK = {10: False, 18: False, 34: False, 50: True, 101: True, 152: True}
 
 
 def space_to_depth(x: jnp.ndarray, block: int = 2) -> jnp.ndarray:

@@ -41,6 +41,11 @@ enable_compile_cache()
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
+# Measured and rejected (2026-07-30): jax_disable_most_optimizations=True
+# cuts per-test XLA compile by ~1/3 but makes the *runtime* of the conv- and
+# step-heavy tests 1.7-2x slower — net suite time went 703s -> 767s. The
+# suite's budget is better served by keeping shapes tiny per-test.
+
 import contextlib  # noqa: E402
 import logging  # noqa: E402
 

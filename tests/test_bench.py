@@ -38,6 +38,9 @@ def test_protocol_record_reports_mfu_when_peak_known(monkeypatch):
 
     kind = getattr(jax.devices()[0], "device_kind", "cpu")
     monkeypatch.setitem(bench.CHIP_PEAK_FLOPS, kind, 1e12)
+    # 2-step windows: the production default of 20 would run 60+ MNIST
+    # steps here just to time them — irrelevant to what this test asserts.
+    monkeypatch.setenv("FRL_BENCH_WINDOW", "2")
     perf = bench.bench_config(
         "mnist_mlp",
         ["data.global_batch_size=64", "trainer.log_every=1000000"],
@@ -50,6 +53,7 @@ def test_protocol_record_reports_mfu_when_peak_known(monkeypatch):
 
 
 def test_run_all_writes_jsonl(tmp_path, monkeypatch):
+    monkeypatch.setenv("FRL_BENCH_WINDOW", "2")
     monkeypatch.setattr(
         bench, "ALL_CONFIGS",
         [("mnist_mlp", ["data.global_batch_size=64"], 4)],

@@ -43,7 +43,7 @@ def test_config2_rn50_ddp(tmp_path):
     smoke_run(
         "imagenet_rn50_ddp",
         [
-            "model.depth=18",
+            "model.depth=10",
             "data.image_size=32",
             "data.num_classes=8",
             "model.num_classes=8",

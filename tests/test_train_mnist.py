@@ -12,7 +12,7 @@ def small_mnist_cfg(tmp_path, **kw):
     cfg = apply_overrides(
         cfg,
         [
-            "trainer.total_steps=60",
+            "trainer.total_steps=30",
             "trainer.log_every=20",
             "trainer.eval_every=0",
             "data.global_batch_size=64",
@@ -29,7 +29,7 @@ def test_mnist_mlp_learns(tmp_path):
     state = trainer.init_state()
 
     losses = []
-    for step in range(60):
+    for step in range(30):
         batch = trainer.pipeline.global_batch(step)
         state, metrics = trainer.train_step(state, batch)
         losses.append(float(metrics["loss"]))
@@ -42,7 +42,7 @@ def test_mnist_fit_loop_and_eval(tmp_path):
     cfg = small_mnist_cfg(tmp_path)
     trainer = Trainer(cfg)
     state, last = trainer.fit()
-    assert int(np.asarray(state.step)) == 60
+    assert int(np.asarray(state.step)) == 30
     assert "loss" in last and last["loss"] < 2.0
     ev = trainer.evaluate(state, num_steps=3)
     assert ev["eval_accuracy"] > 0.5

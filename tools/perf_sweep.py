@@ -118,6 +118,49 @@ def rn50_stem():
         emit("rn50_stem", 512, dt, {"stem": stem})
 
 
+def rn50_split():
+    """Where does the 228ms step go? fwd+loss (train-mode BN) vs fwd+bwd
+    (grad, no update) vs the full step — separates forward, backward and
+    optimizer/update costs with the real training-mode graph."""
+    import jax
+    import jax.numpy as jnp
+
+    t, s, b = build("imagenet_rn50_ddp", ["data.global_batch_size=512"])
+    dt, s = timed_steps(t, s, b)
+    emit("rn50_split_full_step", 512, dt)
+
+    lf = t.loss_fn
+    rng = jax.random.key(0)
+
+    fwd = jax.jit(lambda st, bt: lf(st.params, st.extras, bt, rng, True)[0])
+    for _ in range(3):
+        l = fwd(s, b)
+    jax.device_get(l)
+    t0 = time.perf_counter()
+    for _ in range(10):
+        l = fwd(s, b)
+    jax.device_get(l)
+    emit("rn50_split_fwd_train", 512, (time.perf_counter() - t0) / 10)
+
+    grad = jax.jit(
+        lambda st, bt: jax.grad(
+            lambda p: lf(p, st.extras, bt, rng, True)[0]
+        )(st.params)
+    )
+
+    def gnorm(g):
+        return jnp.sqrt(sum(jnp.vdot(x, x) for x in jax.tree.leaves(g)))
+
+    for _ in range(3):
+        g = grad(s, b)
+    jax.device_get(gnorm(g))
+    t0 = time.perf_counter()
+    for _ in range(10):
+        g = grad(s, b)
+    jax.device_get(gnorm(g))
+    emit("rn50_split_fwd_bwd", 512, (time.perf_counter() - t0) / 10)
+
+
 def vitb():
     for bs in (128, 256, 512):
         t, s, b = build("imagenet_vitb_fsdp", [f"data.global_batch_size={bs}"])
@@ -126,7 +169,7 @@ def vitb():
 
 
 GROUPS = {f.__name__: f for f in (rn50_bs, rn50_precision, rn50_fwd_only,
-                                  rn50_depth, rn50_stem, vitb)}
+                                  rn50_depth, rn50_stem, rn50_split, vitb)}
 
 if __name__ == "__main__":
     which = sys.argv[1:] or list(GROUPS)

@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Summarize a jax.profiler TPU trace: top XLA ops by device time.
+
+Pairs with the capture flow (BASELINE.md backlog, VERDICT r1 item 2):
+
+    python - <<'PY'
+    import jax
+    ... warm up trainer ...
+    jax.profiler.start_trace("/tmp/rn50_trace")
+    ... N steps + device_get ...
+    jax.profiler.stop_trace()
+    PY
+    python tools/trace_analyze.py /tmp/rn50_trace [top_n]
+
+No tensorboard needed: the .xplane.pb is parsed with the protobuf module
+that ships inside tensorflow (tensorflow.tsl.profiler.protobuf).
+"""
+
+from __future__ import annotations
+
+import collections
+import glob
+import os
+import sys
+
+
+def find_xplane(root: str) -> str:
+    hits = sorted(glob.glob(os.path.join(root, "**", "*.xplane.pb"), recursive=True))
+    if not hits:
+        raise FileNotFoundError(f"no .xplane.pb under {root}")
+    return hits[-1]  # latest capture
+
+
+def main() -> int:
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    root = sys.argv[1] if len(sys.argv) > 1 else "/tmp/jax_trace"
+    top_n = int(sys.argv[2]) if len(sys.argv) > 2 else 20
+    path = find_xplane(root)
+    xs = xplane_pb2.XSpace()
+    with open(path, "rb") as fh:
+        xs.ParseFromString(fh.read())
+
+    for plane in xs.planes:
+        if not plane.name.startswith("/device:TPU"):
+            continue
+        emeta = {m.id: m.name for m in plane.event_metadata.values()}
+        for line in plane.lines:
+            if line.name not in ("XLA Ops", "Steps"):
+                continue
+            agg: collections.Counter = collections.Counter()
+            n_events: collections.Counter = collections.Counter()
+            for e in line.events:
+                agg[emeta[e.metadata_id]] += e.duration_ps
+                n_events[emeta[e.metadata_id]] += 1
+            total_ms = sum(agg.values()) / 1e9
+            n_steps = len(line.events) if line.name == "Steps" else max(
+                n_events.values(), default=1
+            )
+            print(f"\n== {plane.name} / {line.name}: {total_ms:.1f} ms total "
+                  f"({len(line.events)} events)")
+            if line.name == "Steps":
+                for name, ps in sorted(agg.items()):
+                    print(f"  step {name}: {ps / 1e9:.2f} ms")
+                continue
+            print(f"  {'ms/step':>8s} {'count':>6s}  op")
+            for name, ps in agg.most_common(top_n):
+                print(
+                    f"  {ps / 1e9 / n_steps:8.2f} {n_events[name]:6d}  {name[:120]}"
+                )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
