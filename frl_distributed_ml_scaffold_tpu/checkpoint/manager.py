@@ -61,9 +61,48 @@ class Checkpointer:
 
     def restore_or_init(self, trainer) -> TrainState:
         step = self.latest_step()
-        if step is not None:
-            return self.restore(trainer.state_shapes, trainer.state_shardings, step)
-        return trainer.init_state()
+        if step is None:
+            return trainer.init_state()
+        shapes, shardings = trainer.state_shapes, trainer.state_shardings
+        try:
+            return self.restore(shapes, shardings, step)
+        except Exception:
+            # Structure mismatch happens when trainer.ema_decay was toggled
+            # across the resume: the checkpoint on disk has (or lacks) the
+            # ema_params subtree relative to the new run's target. Bridge
+            # both directions rather than aborting the resume.
+            if shapes.ema_params is not None:
+                # New run wants EMA, checkpoint predates it: restore without
+                # the EMA subtree and seed it from the restored params.
+                state = self.restore(
+                    shapes.replace(ema_params=None),
+                    shardings.replace(ema_params=None),
+                    step,
+                )
+                self.logger.warning(
+                    "checkpoint step %d has no ema_params (ema_decay was "
+                    "enabled after it was written): seeding EMA from the "
+                    "restored params", step,
+                )
+                # Real copies, not aliases: the train step donates the whole
+                # state, and XLA rejects the same buffer donated twice.
+                import jax.numpy as jnp
+
+                return state.replace(
+                    ema_params=jax.tree.map(jnp.copy, state.params)
+                )
+            # New run dropped EMA, checkpoint has it: restore it alongside
+            # (same shapes/shardings as params) and discard.
+            state = self.restore(
+                shapes.replace(ema_params=shapes.params),
+                shardings.replace(ema_params=shardings.params),
+                step,
+            )
+            self.logger.warning(
+                "checkpoint step %d carries ema_params but ema_decay=0 now: "
+                "discarding the EMA tree", step,
+            )
+            return state.replace(ema_params=None)
 
     def wait(self) -> None:
         self._mngr.wait_until_finished()

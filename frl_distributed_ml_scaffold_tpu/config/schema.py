@@ -123,6 +123,11 @@ class TrainerConfig:
     # [profile_start_step, profile_start_step + profile_steps). 0 = off.
     profile_steps: int = 0
     profile_start_step: int = 10
+    # Exponential moving average of params, updated inside the compiled
+    # step (ema = d*ema + (1-d)*params). 0 = off. When on, eval runs with
+    # the EMA weights (the reason to keep them) and they ride the same
+    # sharding specs + checkpoint as the live params.
+    ema_decay: float = 0.0
 
 
 @dataclass(frozen=True)

@@ -121,7 +121,12 @@ class Trainer:
         inp = jnp.asarray(x[key][:, :-1] if key == "tokens" else x[key])
         variables = dict(self.model.init({"params": rng}, inp, train=False))
         params = variables.pop("params")
-        return TrainState.create(params, self.tx, extras=variables)
+        return TrainState.create(
+            params,
+            self.tx,
+            extras=variables,
+            with_ema=self.cfg.trainer.ema_decay > 0.0,
+        )
 
     def _build_state_shardings(self) -> None:
         cfg, env = self.cfg, self.env
@@ -135,7 +140,12 @@ class Trainer:
         # Non-param collections (BatchNorm stats etc.) are small — replicate.
         e_specs = jax.tree.map(lambda _: P(), state_shapes.extras)
         self.state_specs = TrainState(
-            step=P(), params=p_specs, opt_state=o_specs, extras=e_specs
+            step=P(),
+            params=p_specs,
+            opt_state=o_specs,
+            extras=e_specs,
+            # EMA mirrors params exactly, so it rides the same specs.
+            ema_params=p_specs if state_shapes.ema_params is not None else None,
         )
         self.state_shardings = shardings_from_specs(self.state_specs, env.mesh)
         self.state_shapes = state_shapes
@@ -194,6 +204,7 @@ class Trainer:
             seed=cfg.trainer.seed,
             grad_accum=cfg.trainer.grad_accum,
             remat=cfg.trainer.remat,
+            ema_decay=cfg.trainer.ema_decay,
         )
         # Batch shardings are inferred from the example batch structure.
         example = example_input(cfg.data, cfg.model, batch_size=self.env.batch_axis_size)
@@ -298,6 +309,24 @@ class Trainer:
             num_steps=cfg.trainer.profile_steps,
         )
 
+        # Graceful preemption (TPU maintenance events deliver SIGTERM):
+        # finish the in-flight step, checkpoint, exit cleanly. On a
+        # full-slice preemption every host gets the signal, so the
+        # collective Orbax save below has all participants. Handlers are
+        # process-wide state — install only from the main thread and always
+        # restore (the Trainer may be driven from tests or a supervisor).
+        import signal as _signal
+        import threading as _threading
+
+        preempt = {"signum": None}
+        prev_handlers = {}
+        if _threading.current_thread() is _threading.main_thread():
+            for _sig in (_signal.SIGTERM,):
+                def _graceful(signum, frame, _p=preempt):
+                    _p["signum"] = signum
+
+                prev_handlers[_sig] = _signal.signal(_sig, _graceful)
+
         try:
             for step in range(start_step, total):
                 profiler.step_start(step)
@@ -329,6 +358,34 @@ class Trainer:
                 if cfg.trainer.eval_every and (step + 1) % cfg.trainer.eval_every == 0:
                     eval_metrics = self.evaluate(state)
                     metric_logger.log(step + 1, eval_metrics, {"split": "eval"})
+                if preempt["signum"] is not None:
+                    self.logger.warning(
+                        "signal %d: checkpointing at step %d and exiting "
+                        "cleanly (preemption)", preempt["signum"], step + 1
+                    )
+                    if self.checkpointer is not None:
+                        # Skip the forced save when the periodic one just
+                        # covered this step — re-serializing an identical
+                        # checkpoint burns the fixed preemption grace window.
+                        if (step + 1) % cfg.checkpoint.save_every != 0:
+                            self.checkpointer.save(step + 1, state, force=True)
+                        self.checkpointer.wait()
+                    last_record = metric_logger.log(
+                        step + 1, metrics, {"event": "preempted"}
+                    )
+                    preempt["exited_early"] = True
+                    break
+            # Final-state save runs INSIDE the signal-protected region: a
+            # SIGTERM here (e.g. preemption right as the run finishes) just
+            # sets the flag while the save completes, instead of killing
+            # the process mid-serialization with default disposition. Only
+            # the mid-run preemption break skips it — that path already
+            # saved and waited.
+            if not preempt.get("exited_early") and self.checkpointer is not None:
+                if total % cfg.checkpoint.save_every != 0:
+                    # Final state not yet covered by the periodic save above.
+                    self.checkpointer.save(total, state, force=True)
+                self.checkpointer.wait()
         finally:
             # A crash mid-window must still flush the captured trace (and
             # release the process-wide profiler) — the crash run is exactly
@@ -336,17 +393,18 @@ class Trainer:
             profiler.stop()
             if hasattr(self.pipeline, "close"):
                 self.pipeline.close()  # stop prefetch worker + in-flight work
-        if self.checkpointer is not None:
-            if total % cfg.checkpoint.save_every != 0:
-                # Final state not yet covered by the periodic save above.
-                self.checkpointer.save(total, state, force=True)
-            self.checkpointer.wait()
+            for _sig, _prev in prev_handlers.items():
+                _signal.signal(_sig, _prev)
         metric_logger.close()
         return state, last_record
 
     def evaluate(self, state: TrainState, num_steps: int | None = None) -> dict:
         if self._eval_pipeline is None:
             self._eval_pipeline = build_pipeline(self.cfg.data, self.env, split="eval")
+        if state.ema_params is not None:
+            # The point of keeping an EMA: evaluation runs with it. Same
+            # TrainState structure/shardings, so the compiled eval reuses.
+            state = state.replace(params=state.ema_params)
         n = num_steps or self.cfg.trainer.eval_steps
         acc: dict[str, Any] = {}
         for step in range(n):

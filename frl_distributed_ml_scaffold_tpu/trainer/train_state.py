@@ -11,6 +11,7 @@ from __future__ import annotations
 from typing import Any
 
 import flax.struct
+import jax
 import jax.numpy as jnp
 import optax
 
@@ -23,6 +24,9 @@ class TrainState(flax.struct.PyTreeNode):
     # Under GSPMD these are logically global arrays, so BN statistics reduce
     # over the *global* batch — sync-BN semantics with zero extra code.
     extras: Any
+    # EMA of params when trainer.ema_decay > 0, else None (None is an empty
+    # subtree to jax, so specs/checkpoints are unaffected when off).
+    ema_params: Any = None
 
     @classmethod
     def create(
@@ -30,10 +34,15 @@ class TrainState(flax.struct.PyTreeNode):
         params: Any,
         tx: optax.GradientTransformation,
         extras: Any = None,
+        *,
+        with_ema: bool = False,
     ) -> "TrainState":
         return cls(
             step=jnp.zeros((), jnp.int32),
             params=params,
             opt_state=tx.init(params),
             extras={} if extras is None else extras,
+            # jnp.copy, not an alias: the compiled step donates the state,
+            # and a shared buffer would be donated twice (XLA rejects it).
+            ema_params=jax.tree.map(jnp.copy, params) if with_ema else None,
         )

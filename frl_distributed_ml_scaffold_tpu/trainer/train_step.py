@@ -61,6 +61,7 @@ def make_train_step(
     seed: int = 0,
     grad_accum: int = 1,
     remat: str = "none",
+    ema_decay: float = 0.0,
 ) -> Callable[[TrainState, Any], tuple[TrainState, dict[str, jax.Array]]]:
     """Build the (unjitted) step function; the Trainer jits it with shardings.
 
@@ -144,6 +145,16 @@ def make_train_step(
             opt_state=new_opt_state,
             extras=new_extras,
         )
+        if ema_decay > 0.0:
+            # Inside the same compiled program: fused with the update, and
+            # the EMA tree inherits the params' sharding via the out specs.
+            new_state = new_state.replace(
+                ema_params=jax.tree.map(
+                    lambda e, p: e * ema_decay + p.astype(e.dtype) * (1.0 - ema_decay),
+                    state.ema_params,
+                    new_params,
+                )
+            )
         return new_state, out_metrics
 
     return step_fn

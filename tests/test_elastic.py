@@ -182,3 +182,40 @@ def test_supervisor_kill_and_resume(tmp_path):
         int(d) for d in os.listdir(os.path.join(run_dir, "ckpt")) if d.isdigit()
     )
     assert 12 in ckpt_steps
+
+
+def test_sigterm_preempts_checkpoint_and_resume(tmp_path):
+    """Graceful preemption (TPU maintenance events deliver SIGTERM): the
+    fit loop must finish the in-flight step, checkpoint, and return cleanly
+    — and a fresh run must resume from that checkpoint with no step
+    duplicated or lost."""
+    import signal
+
+    cfg = ckpt_cfg(
+        tmp_path,
+        ["trainer.total_steps=10", "trainer.log_every=2",
+         "checkpoint.save_every=100", "trainer.eval_every=0"],
+    )
+    trainer = Trainer(cfg)
+
+    def send_sigterm_at_step_4(step, metrics):
+        if step == 4:  # zero-based: the 5th step is in flight
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    handler_before = signal.getsignal(signal.SIGTERM)
+    state, last = trainer.fit(on_step=send_sigterm_at_step_4)
+    assert int(jax.device_get(state.step)) == 5  # stopped right after step 5
+    assert last.get("event") == "preempted"
+    # The preemption save is the only one (save_every=100 never fires).
+    assert trainer.checkpointer.latest_step() == 5
+    # fit() restored the pre-existing SIGTERM disposition on exit.
+    assert signal.getsignal(signal.SIGTERM) is handler_before
+
+    resumed = Trainer(cfg)
+    state2, _ = resumed.fit()  # restore_or_init picks up step 5
+    assert int(jax.device_get(state2.step)) == 10
+    with open(os.path.join(str(tmp_path), cfg.name, "metrics.jsonl")) as fh:
+        steps = [json.loads(l)["step"] for l in fh]
+    # Run 1 logs 2, 4, then the preemption record at 5; run 2 resumes from
+    # 5 and logs 6, 8, 10 — contiguous, nothing duplicated.
+    assert steps == [2, 4, 5, 6, 8, 10], steps
