@@ -98,16 +98,20 @@ class PrecisionConfig:
 
 @dataclass(frozen=True)
 class OptimizerConfig:
-    name: str = "adamw"  # adamw | sgd | adam | adafactor
+    name: str = "adamw"  # adamw | sgd | adam | adafactor | lion
     learning_rate: float = 1e-3
     warmup_steps: int = 0
-    schedule: str = "constant"  # constant | cosine | linear
+    schedule: str = "constant"  # constant | cosine | linear | wsd
     weight_decay: float = 0.0
     b1: float = 0.9
-    b2: float = 0.999
+    b2: float = 0.999  # adam-family default; lion maps the untouched 0.999
+    #                    to its canonical 0.99 (see trainer/optimizers.py)
     eps: float = 1e-8  # adam family only (adafactor keeps optax's 1e-30)
     momentum: float = 0.9  # sgd only
     grad_clip_norm: Optional[float] = None
+    # "wsd" only: fraction of post-warmup steps spent in the final linear
+    # decay (the rest holds the peak LR).
+    wsd_decay_fraction: float = 0.2
 
 
 @dataclass(frozen=True)
@@ -234,6 +238,15 @@ class GPTConfig:
     dropout: float = 0.0
     # Attention implementation: "dense" | "ring" | "ulysses" | "flash"
     attention: str = "dense"
+    # Chunked-vocab LM loss: compute the weight-tied head + cross-entropy
+    # in sequence chunks of this many tokens (rematerialized in backward),
+    # so the [B, T, vocab] logits tensor never materializes — for
+    # GPT-2-medium at T=1024 that is ~400 MB of bf16 logits (plus their
+    # cotangents) traded for a scan. 0 = off (dense head). If the sequence
+    # length is not divisible by the chunk, the loss warns and falls back
+    # to the dense head (the knob is a memory optimization, not a
+    # correctness switch).
+    lm_loss_chunk: int = 0
     moe: MoEConfig = field(default_factory=MoEConfig)
     # Pipeline parallelism (SURVEY C7): >1 stages the block stack over the
     # ``pipe`` mesh axis. ``pipeline_microbatches`` = 0 means "same as

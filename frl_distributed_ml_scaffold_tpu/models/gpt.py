@@ -214,7 +214,12 @@ class GPT(nn.Module):
 
     @nn.compact
     def __call__(
-        self, tokens: jnp.ndarray, *, train: bool = False, decode: bool = False
+        self,
+        tokens: jnp.ndarray,
+        *,
+        train: bool = False,
+        decode: bool = False,
+        return_features: bool = False,
     ):
         cfg = self.config
         dtype = self.policy.compute_dtype
@@ -285,6 +290,15 @@ class GPT(nn.Module):
             (x, aux_loss), _ = blocks((x, jnp.zeros((), jnp.float32)), None)
 
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        if return_features:
+            # Pre-head features for the chunked-vocab LM loss (the weight-
+            # tied head lives at params['wte']['embedding']; the loss
+            # reproduces wte.attend chunk by chunk so the [B, T, vocab]
+            # logits tensor never materializes).
+            feats = x.astype(dtype)
+            if cfg.moe.num_experts > 0:
+                return feats, aux_loss
+            return feats
         logits = wte.attend(x.astype(dtype))  # weight-tied LM head
         if cfg.moe.num_experts > 0:
             return logits, aux_loss

@@ -21,6 +21,17 @@ def make_schedule(cfg: OptimizerConfig, total_steps: int) -> optax.Schedule:
         sched = optax.cosine_decay_schedule(base, decay_steps)
     elif cfg.schedule == "linear":
         sched = optax.linear_schedule(base, 0.0, decay_steps)
+    elif cfg.schedule == "wsd":
+        # Warmup-stable-decay: hold the peak LR, then linear-decay over the
+        # final ``wsd_decay_fraction`` of the run — the LM schedule that
+        # decouples total-steps choice from the cosine's fixed horizon.
+        decay = max(int(decay_steps * cfg.wsd_decay_fraction), 1)
+        stable = max(decay_steps - decay, 0)
+        sched = optax.join_schedules(
+            [optax.constant_schedule(base),
+             optax.linear_schedule(base, 0.0, decay)],
+            [stable],
+        )
     else:
         raise KeyError(f"unknown schedule {cfg.schedule!r}")
     if cfg.warmup_steps > 0:
@@ -84,6 +95,20 @@ def make_optimizer(
         if cfg.weight_decay:
             parts.append(optax.add_decayed_weights(cfg.weight_decay))
         parts.append(optax.sgd(schedule, momentum=cfg.momentum, nesterov=True))
+    elif cfg.name == "lion":
+        # Sign-of-momentum optimizer: half the state memory of Adam (one
+        # moment, bf16-friendly) with decoupled weight decay built in.
+        # Canonical LRs are ~3-10x smaller than AdamW's for the same run.
+        # b2: the schema default (0.999) is the ADAM-family value; Lion's
+        # canonical b2 is 0.99 — treat the untouched default as "unset" so
+        # tuning only the LR gets published-Lion dynamics (same policy as
+        # the adafactor-eps case below).
+        b2 = 0.99 if cfg.b2 == 0.999 else cfg.b2
+        parts.append(
+            optax.lion(
+                schedule, b1=cfg.b1, b2=b2, weight_decay=cfg.weight_decay
+            )
+        )
     elif cfg.name == "adafactor":
         # Sublinear-memory LM optimizer (factored second moment). Note for
         # ZeRO users: its v_row/v_col state leaves are not param-shaped, so

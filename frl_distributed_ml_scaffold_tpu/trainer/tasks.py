@@ -16,12 +16,14 @@ import jax.numpy as jnp
 import optax
 
 
-def _apply(model, params, extras, x, rng, train: bool):
+def _apply(model, params, extras, x, rng, train: bool, **kw):
     """Apply with mutable non-param collections in train mode."""
     variables = {"params": params, **extras}
     rngs = {"dropout": rng} if train else None
     mutable = list(extras.keys()) if (train and extras) else False
-    out = model.apply(variables, x, train=train, rngs=rngs, mutable=mutable)
+    out = model.apply(
+        variables, x, train=train, rngs=rngs, mutable=mutable, **kw
+    )
     if mutable:
         y, new_extras = out
         return y, dict(new_extras)
@@ -43,23 +45,75 @@ def make_classification_loss(model, input_key: str = "image"):
 
 
 def make_lm_loss(model):
-    """Next-token CE over ``batch["tokens"]`` (shape [B, L+1])."""
+    """Next-token CE over ``batch["tokens"]`` (shape [B, L+1]).
+
+    With ``model.config.lm_loss_chunk > 0`` the weight-tied head and the
+    cross-entropy run chunk-by-chunk over the sequence inside a
+    ``jax.checkpoint``-ed scan, so only ``[B, chunk, vocab]`` logits ever
+    exist (and are recomputed in the backward) — the memory that otherwise
+    caps the GPT microbatch size is the full ``[B, T, vocab]`` tensor.
+    """
+    chunk = int(getattr(getattr(model, "config", None), "lm_loss_chunk", 0) or 0)
+
+    def _split(out):
+        # MoE models return (logits|feats, aux_loss); dense return one.
+        if isinstance(out, tuple):
+            return out[0], out[1], True
+        return out, jnp.zeros((), jnp.float32), False
+
+    def _chunked_ce(feats, emb, targets):
+        b, t, d = feats.shape
+        n = t // chunk
+        f = feats.reshape(b, n, chunk, d).swapaxes(0, 1)  # [n, B, c, d]
+        tg = targets.reshape(b, n, chunk).swapaxes(0, 1)
+
+        @jax.checkpoint
+        def body(acc, xs):
+            fc, tc = xs
+            # Exactly wte.attend's math on one chunk: dtype-matmul with
+            # fp32 softmax-CE after.
+            logits = (fc @ emb.T).astype(jnp.float32)
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                logits, tc
+            ).sum()
+            return acc + ce, None
+
+        total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (f, tg))
+        return total / (b * t)
+
+    warned = []
 
     def loss_fn(params, extras, batch, rng, train):
         tokens = batch["tokens"]
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
-        out, new_extras = _apply(model, params, extras, inputs, rng, train)
-        # MoE models return (logits, aux_loss); dense return logits.
-        aux_loss = jnp.zeros((), jnp.float32)
-        if isinstance(out, tuple):
-            logits, aux_loss = out
+        use_chunks = chunk > 0 and inputs.shape[1] % chunk == 0
+        if chunk > 0 and not use_chunks and not warned:
+            # Trace-time (not step-time) path, so plain logging is fine.
+            from frl_distributed_ml_scaffold_tpu.utils.logging import get_logger
+
+            get_logger().warning(
+                "lm_loss_chunk=%d does not divide the sequence length %d: "
+                "falling back to the dense [B, T, vocab] head (the memory "
+                "saving is OFF)", chunk, inputs.shape[1],
+            )
+            warned.append(True)
+        out, new_extras = _apply(
+            model, params, extras, inputs, rng, train,
+            **({"return_features": True} if use_chunks else {}),
+        )
+        if use_chunks:
+            feats, aux_loss, is_moe = _split(out)
+            emb = params["wte"]["embedding"].astype(feats.dtype)
+            ce = _chunked_ce(feats, emb, targets)
         else:
-            logits = out
-        logits = logits.astype(jnp.float32)
-        ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets).mean()
+            logits, aux_loss, is_moe = _split(out)
+            logits = logits.astype(jnp.float32)
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                logits, targets
+            ).mean()
         loss = ce + aux_loss
         metrics = {"ce_loss": ce, "perplexity": jnp.exp(ce)}
-        if isinstance(out, tuple):
+        if is_moe:
             metrics["aux_loss"] = aux_loss
         return loss, (metrics, new_extras)
 

@@ -1,0 +1,83 @@
+"""Chunked-vocab LM loss (model.lm_loss_chunk): identical loss and grads to
+the dense head, without ever materializing [B, T, vocab] logits."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from frl_distributed_ml_scaffold_tpu.config.schema import GPTConfig, PrecisionConfig
+from frl_distributed_ml_scaffold_tpu.models.gpt import GPT
+from frl_distributed_ml_scaffold_tpu.precision import get_policy
+from frl_distributed_ml_scaffold_tpu.trainer.tasks import make_lm_loss
+
+FP32 = get_policy(PrecisionConfig(policy="fp32"))
+TINY = dict(
+    vocab_size=96, num_layers=2, num_heads=2, hidden_dim=32, seq_len=16, dropout=0.0
+)
+
+
+def loss_and_grads(cfg, tokens, params):
+    model = GPT(cfg, FP32)
+    lf = make_lm_loss(model)
+    batch = {"tokens": tokens}
+
+    def scalar(p):
+        return lf(p, {}, batch, jax.random.key(0), False)[0]
+
+    (loss, (metrics, _)) = lf(params, {}, batch, jax.random.key(0), False)
+    return loss, metrics, jax.grad(scalar)(params)
+
+
+def test_chunked_loss_matches_dense_head():
+    base = GPTConfig(**TINY)
+    tokens = jax.random.randint(jax.random.key(3), (4, 17), 0, 96)
+    params = GPT(base, FP32).init(
+        {"params": jax.random.key(0)}, tokens[:, :-1], train=False
+    )["params"]
+    loss_d, met_d, g_d = loss_and_grads(base, tokens, params)
+    for chunk in (4, 8, 16):
+        cc = dataclasses.replace(base, lm_loss_chunk=chunk)
+        loss_c, met_c, g_c = loss_and_grads(cc, tokens, params)
+        np.testing.assert_allclose(loss_c, loss_d, atol=1e-6, rtol=1e-6)
+        np.testing.assert_allclose(
+            met_c["ce_loss"], met_d["ce_loss"], atol=1e-6, rtol=1e-6
+        )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-4),
+            g_c,
+            g_d,
+        )
+
+
+def test_chunked_loss_moe_keeps_aux():
+    from frl_distributed_ml_scaffold_tpu.config.schema import MoEConfig
+
+    cfg = dataclasses.replace(
+        GPTConfig(**TINY, moe=MoEConfig(num_experts=4, top_k=2)),
+        lm_loss_chunk=8,
+    )
+    dense = dataclasses.replace(cfg, lm_loss_chunk=0)
+    tokens = jax.random.randint(jax.random.key(5), (4, 17), 0, 96)
+    params = GPT(dense, FP32).init(
+        {"params": jax.random.key(0)}, tokens[:, :-1], train=False
+    )["params"]
+    loss_c, met_c, _ = loss_and_grads(cfg, tokens, params)
+    loss_d, met_d, _ = loss_and_grads(dense, tokens, params)
+    np.testing.assert_allclose(loss_c, loss_d, atol=1e-6, rtol=1e-6)
+    assert met_c["aux_loss"] > 0
+    np.testing.assert_allclose(met_c["aux_loss"], met_d["aux_loss"], rtol=1e-6)
+
+
+def test_indivisible_seq_falls_back_to_dense():
+    """seq not divisible by the chunk: silently use the dense head (the
+    config is a memory knob, not a correctness switch)."""
+    cc = dataclasses.replace(GPTConfig(**TINY), lm_loss_chunk=5)  # 16 % 5 != 0
+    tokens = jax.random.randint(jax.random.key(7), (2, 17), 0, 96)
+    params = GPT(cc, FP32).init(
+        {"params": jax.random.key(0)}, tokens[:, :-1], train=False
+    )["params"]
+    loss_c, _, _ = loss_and_grads(cc, tokens, params)
+    loss_d, _, _ = loss_and_grads(GPTConfig(**TINY), tokens, params)
+    np.testing.assert_allclose(loss_c, loss_d, atol=1e-7)
