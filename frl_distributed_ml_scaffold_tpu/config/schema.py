@@ -104,8 +104,9 @@ class OptimizerConfig:
     schedule: str = "constant"  # constant | cosine | linear | wsd
     weight_decay: float = 0.0
     b1: float = 0.9
-    b2: float = 0.999  # adam-family default; lion maps the untouched 0.999
-    #                    to its canonical 0.99 (see trainer/optimizers.py)
+    # None = the optimizer's own canonical default (0.999 for the adam
+    # family, 0.99 for lion); an explicit value is always honored.
+    b2: Optional[float] = None
     eps: float = 1e-8  # adam family only (adafactor keeps optax's 1e-30)
     momentum: float = 0.9  # sgd only
     grad_clip_norm: Optional[float] = None

@@ -79,18 +79,20 @@ def make_optimizer(
     parts = []
     if cfg.grad_clip_norm is not None:
         parts.append(optax.clip_by_global_norm(cfg.grad_clip_norm))
+    # b2=None -> each optimizer's canonical default (schema contract).
+    adam_b2 = 0.999 if cfg.b2 is None else cfg.b2
     if cfg.name == "adamw":
         parts.append(
             optax.adamw(
                 schedule,
                 b1=cfg.b1,
-                b2=cfg.b2,
+                b2=adam_b2,
                 eps=cfg.eps,
                 weight_decay=cfg.weight_decay,
             )
         )
     elif cfg.name == "adam":
-        parts.append(optax.adam(schedule, b1=cfg.b1, b2=cfg.b2, eps=cfg.eps))
+        parts.append(optax.adam(schedule, b1=cfg.b1, b2=adam_b2, eps=cfg.eps))
     elif cfg.name == "sgd":
         if cfg.weight_decay:
             parts.append(optax.add_decayed_weights(cfg.weight_decay))
@@ -99,14 +101,14 @@ def make_optimizer(
         # Sign-of-momentum optimizer: half the state memory of Adam (one
         # moment, bf16-friendly) with decoupled weight decay built in.
         # Canonical LRs are ~3-10x smaller than AdamW's for the same run.
-        # b2: the schema default (0.999) is the ADAM-family value; Lion's
-        # canonical b2 is 0.99 — treat the untouched default as "unset" so
-        # tuning only the LR gets published-Lion dynamics (same policy as
-        # the adafactor-eps case below).
-        b2 = 0.99 if cfg.b2 == 0.999 else cfg.b2
+        # b2=None -> Lion's canonical 0.99 (NOT the adam family's 0.999);
+        # an explicit value — including 0.999 — is honored as-is.
         parts.append(
             optax.lion(
-                schedule, b1=cfg.b1, b2=b2, weight_decay=cfg.weight_decay
+                schedule,
+                b1=cfg.b1,
+                b2=0.99 if cfg.b2 is None else cfg.b2,
+                weight_decay=cfg.weight_decay,
             )
         )
     elif cfg.name == "adafactor":
