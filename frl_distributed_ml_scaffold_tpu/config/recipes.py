@@ -172,6 +172,27 @@ def gpt2_ring() -> ExperimentConfig:
     )
 
 
+@register_config("gpt2_long")
+def gpt2_long() -> ExperimentConfig:
+    """Single-chip long context (SURVEY C8 complement to ``gpt2_ring``):
+    8k tokens through the Pallas flash kernel (O(block) memory, measured
+    to 32k on one v5e — BASELINE.md) with the chunked-vocab loss and full
+    remat keeping activations off HBM. No sequence axis needed until the
+    context outgrows the chip."""
+    base = gpt2_medium_zero1()
+    return base.replace(
+        name="gpt2_long",
+        model=GPTConfig(
+            vocab_size=50257, num_layers=24, num_heads=16, hidden_dim=1024,
+            seq_len=8192, attention="flash", lm_loss_chunk=256,
+        ),
+        data=DataConfig(name="lm_synthetic", global_batch_size=8, seq_len=8192),
+        mesh=MeshConfig(data=-1),
+        parallel=ParallelConfig(param_sharding="replicated"),
+        trainer=dataclasses.replace(base.trainer, grad_accum=8, remat="full"),
+    )
+
+
 @register_config("gpt2_moe")
 def gpt2_moe() -> ExperimentConfig:
     """Expert-parallel MoE LM (SURVEY C9)."""
@@ -201,4 +222,17 @@ def gpt2_pp() -> ExperimentConfig:
         mesh=MeshConfig(data=-1, pipe=4),
         parallel=ParallelConfig(param_sharding="replicated"),
         trainer=dataclasses.replace(base.trainer, grad_accum=1),
+    )
+
+
+@register_config("gpt2_pp_circular")
+def gpt2_pp_circular() -> ExperimentConfig:
+    """Circular (interleaved) pipeline: same 4 physical stages as
+    ``gpt2_pp`` but each holds 2 virtual layer groups, cutting the bubble
+    from 3/11 to 3/19 of a step at the cost of rotating activations
+    through the ring twice."""
+    base = gpt2_pp()
+    return base.replace(
+        name="gpt2_pp_circular",
+        model=dataclasses.replace(base.model, pipeline_circular_repeat=2),
     )

@@ -426,3 +426,53 @@ def test_moe_recipe_runs(tmp_path):
         state, metrics = trainer.train_step(state, batch)
         losses.append(float(metrics["loss"]))
     assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+def test_long_context_recipe_runs(tmp_path):
+    """Single-chip long-context recipe (gpt2_long): flash + chunked-vocab
+    loss + full remat, shrunk to CI size (flash falls back to dense off-TPU
+    with identical numerics)."""
+    smoke_run(
+        "gpt2_long",
+        [
+            "model.vocab_size=128",
+            "model.num_layers=2",
+            "model.num_heads=4",
+            "model.hidden_dim=64",
+            "model.seq_len=256",
+            "model.lm_loss_chunk=64",
+            "data.vocab_size=128",
+            "data.seq_len=256",
+            "data.global_batch_size=8",
+            "trainer.grad_accum=2",
+            "mesh.data=8",
+            "optimizer.warmup_steps=0",
+        ],
+        tmp_path,
+        steps=6,
+    )
+
+
+def test_circular_pp_recipe_runs(tmp_path):
+    """gpt2_pp_circular: the interleaved schedule end-to-end on a pipe=4
+    mesh, with the bubble improvement visible in the summary."""
+    from frl_distributed_ml_scaffold_tpu.parallel.pipeline import pipeline_summary
+
+    overrides = [
+        "model.vocab_size=128",
+        "model.num_layers=8",
+        "model.num_heads=2",
+        "model.hidden_dim=32",
+        "model.seq_len=32",
+        "model.pipeline_microbatches=4",
+        "data.vocab_size=128",
+        "data.seq_len=32",
+        "data.global_batch_size=8",
+        "mesh.pipe=4",
+        "mesh.data=2",
+        "optimizer.warmup_steps=0",
+        "optimizer.learning_rate=0.01",
+        "trainer.grad_accum=1",
+    ]
+    cfg = apply_overrides(get_config("gpt2_pp_circular"), overrides)
+    assert "circular(x2)" in pipeline_summary(cfg.model)
+    smoke_run("gpt2_pp_circular", overrides, tmp_path, steps=5)
