@@ -87,10 +87,11 @@ def bench_config(name: str, overrides: list[str], *, steps: int, warmup: int):
     # window pipeline as in a real training loop (per-step syncs would
     # charge the host<->device round-trip latency to every step).
     # ``warmup`` counts windows (the first ones contain compile + ramp).
-    # 20 steps/window: the relay's sync RTT is ~20 ms, which a 5-step
-    # window charged as ~4 ms/step (-10% on RN50); real training loops
-    # sync far less often than that.
-    window = int(os.environ.get("FRL_BENCH_WINDOW", "20"))
+    # 30 steps/window: the relay's sync RTT is ~20 ms, which a 5-step
+    # window charged as ~4 ms/step (-10% on RN50) and a 20-step window as
+    # ~1 ms/step; real training loops sync once per log_every (100s of
+    # steps), so 30 still over-charges relative to production.
+    window = int(os.environ.get("FRL_BENCH_WINDOW", "30"))
     n_windows = max(1, -(-steps // window))  # ceil; at least one measured
     timer = StepTimer(warmup=warmup)
     for _ in range(n_windows + warmup + 1):
@@ -224,7 +225,7 @@ CANDIDATES = [
         # (models/resnet.py), measured +1.5% over conv7.
         ["data.global_batch_size=512", "model.stem=s2d",
          "trainer.log_every=1000000"],
-        60,
+        90,  # 3 measured 30-step windows (median taken across windows)
     ),
     (
         "mnist_mlp_samples_per_sec_per_chip",

@@ -200,6 +200,13 @@ class ResNetConfig:
     # "conv7" = torchvision 7x7/s2 stem; "s2d" = the mathematically exact
     # space-to-depth rewrite (MXU-friendly; see models/resnet.py).
     stem: str = "conv7"
+    # Stem max-pool backward: "scatter" = XLA select_and_scatter (the
+    # autodiff default; first-max-wins on ties, and the faster path on
+    # v5e — "mask" measured ~8% slower end-to-end, see BASELINE.md
+    # "measured and rejected"); "mask" = custom-VJP compare-and-sum pass
+    # whose tie semantics split the gradient equally across tied maxima
+    # (models/resnet.py::_max_pool_mask_grad).
+    pool_grad: str = "scatter"
 
 
 @dataclass(frozen=True)

@@ -15,7 +15,9 @@ from functools import partial
 from typing import Any, Callable, Sequence
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
+from jax import lax
 
 from frl_distributed_ml_scaffold_tpu.config.schema import ResNetConfig
 from frl_distributed_ml_scaffold_tpu.precision import Policy
@@ -73,6 +75,93 @@ def s2d_stem_weights(w7: jnp.ndarray) -> jnp.ndarray:
                     ch = (dh * 2 + dw) * c
                     w4 = w4.at[kh, kw, ch : ch + c, :].set(w7[ih, iw])
     return w4
+
+
+def _stem_max_pool(x: jnp.ndarray) -> jnp.ndarray:
+    return nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+
+
+def _tap_shift(a: jnp.ndarray, dh: int, dw: int, fill) -> jnp.ndarray:
+    """out[h, w] = a[h - dh, w - dw] with ``fill`` where out of range."""
+    _, h, w, _ = a.shape
+    ap = jnp.pad(a, ((0, 0), (dh, 0), (dw, 0), (0, 0)), constant_values=fill)
+    return ap[:, :h, :w, :]
+
+
+@jax.custom_vjp
+def _max_pool_mask_grad(x: jnp.ndarray) -> jnp.ndarray:
+    """3x3/s2 SAME max pool whose backward is a compare-and-sum pass.
+
+    Autodiff of ``reduce_window(max)`` lowers to ``select_and_scatter``,
+    which the v5e profiler trace pins at a fixed 3.5 ms/step on RN50's
+    ``[B, 112, 112, 64]`` stem activations (BASELINE.md). The gradient is
+    re-expressed as two fused elementwise passes: (1) per window, count how
+    many entries equal the max; (2) per input position, sum ``dy/count``
+    over the <=4 covering windows whose max it equals — both 9-tap stencils
+    XLA fuses into single bandwidth-shaped kernels (~40% cheaper than the
+    scatter). Tie semantics differ from autodiff: tied maxima split the
+    gradient equally (a valid subgradient, gradient-mass preserving) where
+    select_and_scatter routes it all to the first maximum; tie-free grads
+    are identical (tested), and in RN50 the pool input is post-ReLU, where
+    all-zero windows — the common tie — get their gradient killed by the
+    ReLU backward regardless.
+    """
+    _check_mask_pool_shape(x)  # fail at trace time, not first grad
+    return _stem_max_pool(x)
+
+
+def _check_mask_pool_shape(x) -> None:
+    _, h, w, _ = x.shape
+    if h % 2 or w % 2:
+        raise ValueError(
+            "pool_grad='mask' needs even pool-INPUT spatial dims (its "
+            f"dilation math assumes exact stride-2 coverage); got {h}x{w} "
+            "into the stem pool — use pool_grad='scatter' for odd sizes"
+        )
+
+
+def _mpm_fwd(x):
+    _check_mask_pool_shape(x)
+    y = _stem_max_pool(x)
+    return y, (x, y)
+
+
+def _mpm_bwd(res, dy):
+    x, y = res
+    b, h, w, c = x.shape
+    ho, wo = y.shape[1], y.shape[2]
+    neg = jnp.array(-jnp.inf, x.dtype)
+    # Pass 1 — count[p] = |{window entries == max}|. SAME padding for k=3,
+    # s=2 on even dims pads (0, 1): window p reads inputs [2p, 2p+2].
+    xp = jnp.pad(x, ((0, 0), (0, 2), (0, 2), (0, 0)), constant_values=neg)
+    count = jnp.zeros(y.shape, dy.dtype)
+    for th in range(3):
+        for tw in range(3):
+            patch = lax.slice(
+                xp,
+                (0, th, tw, 0),
+                (b, th + 2 * ho - 1, tw + 2 * wo - 1, c),
+                (1, 2, 2, 1),
+            )
+            count = count + (patch == y).astype(dy.dtype)
+    scaled = dy / count  # count >= 1: the max itself is always in-window
+    # Pass 2 — scatter-as-gather: dilate (y, dy/count) onto the input grid
+    # (odd slots get -inf so they can never match) and sum the <=9 taps
+    # whose window max equals x at this position. lax.pad interior dilation
+    # (not .at[::2].set, which lowers to a scatter) keeps this fusible.
+    dilate = ((0, 0, 0), (0, 1, 1), (0, 1, 1), (0, 0, 0))
+    yd = lax.pad(y, neg, dilate)
+    sd = lax.pad(scaled, jnp.zeros((), dy.dtype), dilate)
+    dx = jnp.zeros_like(x)
+    for dh in range(3):
+        for dw in range(3):
+            y_tap = _tap_shift(yd, dh, dw, neg)
+            s_tap = _tap_shift(sd, dh, dw, jnp.zeros((), dy.dtype))
+            dx = dx + jnp.where(x == y_tap, s_tap, 0).astype(x.dtype)
+    return (dx,)
+
+
+_max_pool_mask_grad.defvjp(_mpm_fwd, _mpm_bwd)
 
 
 class BottleneckBlock(nn.Module):
@@ -161,7 +250,15 @@ class ResNet(nn.Module):
             )
         x = norm()(x)
         x = nn.relu(x)
-        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        if cfg.pool_grad == "mask":
+            x = _max_pool_mask_grad(x)
+        elif cfg.pool_grad == "scatter":
+            x = _stem_max_pool(x)
+        else:
+            raise ValueError(
+                f"unknown pool_grad {cfg.pool_grad!r}; "
+                "expected 'scatter' or 'mask'"
+            )
 
         block_cls = BottleneckBlock if BOTTLENECK[cfg.depth] else BasicBlock
         for stage, n_blocks in enumerate(STAGE_SIZES[cfg.depth]):

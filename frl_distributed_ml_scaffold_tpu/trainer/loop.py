@@ -83,6 +83,20 @@ class Trainer:
     def __init__(self, cfg: ExperimentConfig, *, mesh_env: MeshEnv | None = None):
         self.cfg = cfg
         self.logger = get_logger()
+        # Labels/tokens >= the model's output range make the CE loss NaN
+        # while the grads stay finite (XLA clamps the out-of-bounds label
+        # gather), which trains garbage that *looks* alive in the logs —
+        # refuse up front. num_classes covers the classifiers, vocab_size
+        # the LMs; the invariant is the same label-range one.
+        for attr in ("num_classes", "vocab_size"):
+            d_v = getattr(cfg.data, attr, None)
+            m_v = getattr(cfg.model, attr, None)
+            if d_v is not None and m_v is not None and d_v != m_v:
+                raise ValueError(
+                    f"config {cfg.name}: data.{attr}={d_v} != "
+                    f"model.{attr}={m_v}; labels out of the model's range "
+                    "silently NaN the loss — override both together"
+                )
         self.env = mesh_env if mesh_env is not None else build_mesh(cfg.mesh)
         self.policy = get_policy(cfg.precision)
         self.model = create_model(cfg.model, self.policy)

@@ -1,9 +1,10 @@
 """Step timing + throughput measurement (SURVEY C19, BASELINE.md protocol).
 
-The contract: timings exclude compile (warmup window), are measured with
-``jax.block_until_ready`` on the step output, and report median + p90 e2e
-step time plus samples/sec/chip — the benchmark harness and the trainer both
-use this one implementation so numbers agree.
+The contract: timings exclude compile (warmup window), force true device
+completion via ``device_get`` of the step's scalar outputs (see ``_force``
+for why not ``block_until_ready``), and report median + p90 e2e step time
+plus samples/sec/chip — the benchmark harness and the trainer both use this
+one implementation so numbers agree.
 """
 
 from __future__ import annotations
@@ -16,22 +17,21 @@ import numpy as np
 
 
 def _force(out) -> None:
-    """Force true device completion of ``out``.
+    """Force true device completion of ``out`` (per-step scalars, e.g. loss).
 
-    ``jax.block_until_ready`` is NOT sufficient on every platform: the
-    experimental axon TPU plugin reports donated/aliased buffers ready
-    immediately, which silently turns step timing into dispatch timing
-    (observed: "1.5ms" RN50 steps that are really 207ms). ``device_get`` of
-    a scalar forces the real data dependency, so pass a per-step scalar
-    output (e.g. the loss) as ``out``.
+    ``jax.block_until_ready`` is doubly wrong on the experimental axon TPU
+    relay: it reports donated/aliased buffers ready immediately, silently
+    turning step timing into dispatch timing (observed: "1.5ms" RN50 steps
+    that are really 207ms) — and on live buffers it issues a slow
+    stream-sync RPC (~75 ms/call measured 2026-07-30, +2.5 ms/step charged
+    to 30-step windows) on top of the fetch. ``device_get`` of each leaf
+    both forces the real data dependency and is the exact operation the
+    training loop's metric fetch performs at log boundaries, so timed
+    windows measure what production steps cost — no more, no less.
     """
     if out is None:
         return
-    small = jax.tree.leaves(out)
-    if small:
-        smallest = min(small, key=lambda x: getattr(x, "size", 0))
-        jax.device_get(smallest)
-    jax.block_until_ready(out)
+    jax.device_get(out)  # one fetch for the whole (scalar-leaved) pytree
 
 
 @dataclass
@@ -42,8 +42,10 @@ class StepTimer:
 
         timer = StepTimer(warmup=3)
         for batch in data:
-            out = train_step(state, batch)
-            timer.tick(out)          # block_until_ready + record
+            state, metrics = train_step(state, batch)
+            timer.tick(metrics["loss"])  # force a per-step SCALAR + record
+                                         # (never the state: _force fetches
+                                         # everything it is handed)
     """
 
     warmup: int = 3

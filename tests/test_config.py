@@ -82,3 +82,16 @@ def test_roundtrip_dict():
 def test_mlp_default():
     m = MLPConfig()
     assert m.family == "mlp"
+
+
+def test_trainer_refuses_num_classes_mismatch():
+    """Labels >= model.num_classes NaN the CE loss while grads stay finite
+    (clamped gather) — the Trainer must refuse the config up front."""
+    from frl_distributed_ml_scaffold_tpu.trainer.loop import Trainer
+
+    cfg = apply_overrides(
+        get_config("mnist_mlp"),
+        ["model.num_classes=7", "data.global_batch_size=8"],
+    )
+    with pytest.raises(ValueError, match="num_classes"):
+        Trainer(cfg)

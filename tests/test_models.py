@@ -109,6 +109,62 @@ def test_s2d_stem_is_exact_rewrite_of_conv7():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
 
 
+def test_maxpool_mask_grad_matches_scatter():
+    """pool_grad='mask' must be the identical function forward and, on
+    tie-free inputs, produce the identical gradient as the autodiff
+    select_and_scatter path (it is a perf knob, not an architecture
+    change). Continuous fp32 random inputs make ties measure-zero."""
+    from frl_distributed_ml_scaffold_tpu.models.resnet import (
+        _max_pool_mask_grad,
+        _stem_max_pool,
+    )
+
+    x = jax.random.normal(jax.random.key(0), (2, 8, 8, 4))
+    w = jax.random.normal(jax.random.key(1), (2, 4, 4, 4))
+
+    def loss(pool, x):
+        return jnp.sum(pool(x) * w)
+
+    np.testing.assert_array_equal(
+        np.asarray(_max_pool_mask_grad(x)), np.asarray(_stem_max_pool(x))
+    )
+    g_ref = jax.grad(lambda x: loss(_stem_max_pool, x))(x)
+    g_mask = jax.grad(lambda x: loss(_max_pool_mask_grad, x))(x)
+    np.testing.assert_allclose(
+        np.asarray(g_mask), np.asarray(g_ref), rtol=1e-6
+    )
+
+
+def test_maxpool_mask_grad_ties_preserve_mass():
+    """On tied maxima the mask path splits gradient equally across the tied
+    entries (select_and_scatter routes all of it to the first); both must
+    conserve total gradient mass per window."""
+    from frl_distributed_ml_scaffold_tpu.models.resnet import (
+        _max_pool_mask_grad,
+    )
+
+    x = jnp.ones((1, 4, 4, 1))  # every window fully tied
+    dy_total = 4.0  # 2x2 output of ones
+    g = jax.grad(lambda x: jnp.sum(_max_pool_mask_grad(x)))(x)
+    np.testing.assert_allclose(float(jnp.sum(g)), dy_total, rtol=1e-6)
+    assert float(jnp.max(g)) < 1.0  # actually split, not first-takes-all
+
+
+def test_resnet_pool_grad_mask_trains():
+    model = create_model(
+        ResNetConfig(depth=18, num_classes=7, pool_grad="mask"), FP32
+    )
+    x = jax.random.normal(jax.random.key(2), (2, 32, 32, 3))
+    variables, logits = init_and_forward(model, x)
+    assert logits.shape == (2, 7)
+    g = jax.grad(
+        lambda p: model.apply(
+            {**variables, "params": p}, x, train=False
+        ).sum()
+    )(variables["params"])
+    assert all(np.isfinite(l).all() for l in jax.tree.leaves(g))
+
+
 def test_resnet_s2d_stem_trains():
     model = create_model(
         ResNetConfig(depth=18, num_classes=7, stem="s2d"), FP32

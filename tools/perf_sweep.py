@@ -168,8 +168,38 @@ def vitb():
         emit("vitb_bs", bs, dt)
 
 
+def rn50_headline():
+    """Exactly the bench.py headline candidate (s2d stem, bs=512), timed
+    with a long window so XLA-flag experiments (tools/xla_flag_sweep.py —
+    flags must be set before jax init, hence one subprocess per flag set)
+    compare step time, not relay sync RTT."""
+    import os
+
+    t, s, b = build(
+        "imagenet_rn50_ddp",
+        ["data.global_batch_size=512", "model.stem=s2d"],
+    )
+    dt, _ = timed_steps(t, s, b, n=30, warm=4)
+    emit("rn50_headline", 512, dt,
+         {"xla_flags": os.environ.get("XLA_FLAGS", "")})
+
+
+def rn50_pool():
+    """select_and_scatter vs the mask-based custom-VJP maxpool backward
+    (models/resnet.py::_max_pool_mask_grad) on the headline candidate."""
+    for pg in ("scatter", "mask"):
+        t, s, b = build(
+            "imagenet_rn50_ddp",
+            ["data.global_batch_size=512", "model.stem=s2d",
+             f"model.pool_grad={pg}"],
+        )
+        dt, _ = timed_steps(t, s, b, n=30, warm=4)
+        emit("rn50_pool", 512, dt, {"pool_grad": pg})
+
+
 GROUPS = {f.__name__: f for f in (rn50_bs, rn50_precision, rn50_fwd_only,
-                                  rn50_depth, rn50_stem, rn50_split, vitb)}
+                                  rn50_depth, rn50_stem, rn50_split, vitb,
+                                  rn50_headline, rn50_pool)}
 
 if __name__ == "__main__":
     which = sys.argv[1:] or list(GROUPS)

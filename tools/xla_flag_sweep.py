@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""XLA compiler-flag sweep for the RN50 headline candidate.
+
+XLA_FLAGS are frozen when the backend initializes, so each flag set runs
+``tools/perf_sweep.py rn50_headline`` in its own bounded subprocess; an
+unknown flag (XLA hard-errors on those) or a compile hang is recorded as
+an error line, not a sweep abort. Candidate list: the public single-chip
+TPU tuning surface — scoped-VMEM budget (bigger fusions for the
+bandwidth-bound BN-backward passes that dominate the RN50 step, see
+BASELINE.md trace analysis) and the memory-bound-loop / prefetch knobs.
+
+    python tools/xla_flag_sweep.py            # full sweep
+    python tools/xla_flag_sweep.py 0 2 5      # sweep indices
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+TIMEOUT_S = int(os.environ.get("FRL_SWEEP_TIMEOUT_S", "420"))
+
+# Each entry: extra XLA_FLAGS appended to the environment's own.
+CANDIDATES: list[str] = [
+    "",  # baseline (re-measured in the same session for a fair delta)
+    "--xla_tpu_scoped_vmem_limit_kib=49152",
+    "--xla_tpu_scoped_vmem_limit_kib=65536",
+    "--xla_tpu_scoped_vmem_limit_kib=98304",
+    # Memory-space-assignment prefetch aggressiveness (async HBM->VMEM
+    # copies overlapping compute; relevant when fusions are bandwidth-bound).
+    "--xla_tpu_async_copy_bandwidth_scaling_factor=2.0",
+    "--xla_vf_vmem_max_overlap_to_mem_size_async_copy_ratio=10",
+    # Loop-invariant code motion size budget (hoists more out of loops).
+    "--xla_tpu_licm_size_inflation_ratio=2.0",
+    # Combined best-of candidates get appended by hand after a first pass.
+]
+
+
+def run_one(flags: str) -> dict:
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " + flags).strip()
+    # No compile-cache handling needed: perf_sweep never enables the
+    # persistent cache, so every flag set compiles fresh.
+    t0 = time.perf_counter()
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(os.path.dirname(__file__),
+                                          "perf_sweep.py"), "rn50_headline"],
+            capture_output=True, text=True, timeout=TIMEOUT_S, env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+    except subprocess.TimeoutExpired:
+        return {"flags": flags, "error": f"timeout after {TIMEOUT_S}s"}
+    dt = time.perf_counter() - t0
+    for line in r.stdout.splitlines():
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if rec.get("experiment") == "rn50_headline":
+            rec["flags"] = flags
+            rec["wall_s"] = round(dt, 1)
+            return rec
+    return {"flags": flags, "error": (r.stderr.strip()[-300:] or
+                                      f"no result line (rc={r.returncode})")}
+
+
+def main() -> int:
+    idxs = [int(a) for a in sys.argv[1:]] or range(len(CANDIDATES))
+    for i in idxs:
+        flags = CANDIDATES[i]
+        print(f"[{i}] {flags or '(baseline)'}", file=sys.stderr, flush=True)
+        print(json.dumps(run_one(flags)), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
