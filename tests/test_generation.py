@@ -9,6 +9,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from _jit import jit_apply, jit_init
+
 from frl_distributed_ml_scaffold_tpu.config.schema import GPTConfig, PrecisionConfig
 from frl_distributed_ml_scaffold_tpu.models.generation import generate
 from frl_distributed_ml_scaffold_tpu.models.gpt import GPT
@@ -24,7 +26,7 @@ TINY = dict(
 def gpt():
     model = GPT(GPTConfig(**TINY), FP32)
     tokens = jax.random.randint(jax.random.key(1), (2, 8), 0, 64)
-    params = model.init({"params": jax.random.key(0)}, tokens, train=False)["params"]
+    params = jit_init(model, tokens, train=False)["params"]
     return model, params, tokens
 
 
@@ -32,9 +34,9 @@ def test_prefill_matches_full_forward(gpt):
     """Decode-mode prefill (masked attention over the padded cache) must
     equal the plain causal forward at every prompt position."""
     model, params, tokens = gpt
-    full = model.apply({"params": params}, tokens, train=False)
-    prefill, _ = model.apply(
-        {"params": params}, tokens, decode=True, mutable=["cache"]
+    full = jit_apply(model, train=False)({"params": params}, tokens)
+    prefill, _ = jit_apply(model, decode=True, mutable=["cache"])(
+        {"params": params}, tokens
     )
     np.testing.assert_allclose(full, prefill, atol=1e-5, rtol=1e-5)
 
@@ -44,17 +46,16 @@ def test_stepwise_decode_matches_full_forward(gpt):
     full forward's next-token logits at every step — the KV cache is
     correct, not just self-consistent."""
     model, params, tokens = gpt
-    full = model.apply({"params": params}, tokens, train=False)
-    _, vars_out = model.apply(
-        {"params": params}, tokens[:, :1], decode=True, mutable=["cache"]
+    full = jit_apply(model, train=False)({"params": params}, tokens)
+    _, vars_out = jit_apply(model, decode=True, mutable=["cache"])(
+        {"params": params}, tokens[:, :1]
     )
     cache = vars_out["cache"]
+    # One compiled single-token step reused across the whole decode loop.
+    step = jit_apply(model, decode=True, mutable=["cache"])
     for i in range(1, tokens.shape[1]):
-        logits, vars_out = model.apply(
-            {"params": params, "cache": cache},
-            tokens[:, i : i + 1],
-            decode=True,
-            mutable=["cache"],
+        logits, vars_out = step(
+            {"params": params, "cache": cache}, tokens[:, i : i + 1]
         )
         cache = vars_out["cache"]
         np.testing.assert_allclose(

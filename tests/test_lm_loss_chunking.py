@@ -26,8 +26,12 @@ def loss_and_grads(cfg, tokens, params):
     def scalar(p):
         return lf(p, {}, batch, jax.random.key(0), False)[0]
 
-    (loss, (metrics, _)) = lf(params, {}, batch, jax.random.key(0), False)
-    return loss, metrics, jax.grad(scalar)(params)
+    # jit: one compiled (and persistently cached) program per chunk size
+    # instead of eager op-by-op dispatch of the whole fwd+bwd.
+    (loss, (metrics, _)) = jax.jit(
+        lambda p: lf(p, {}, batch, jax.random.key(0), False)
+    )(params)
+    return loss, metrics, jax.jit(jax.grad(scalar))(params)
 
 
 def test_chunked_loss_matches_dense_head():

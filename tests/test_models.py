@@ -6,6 +6,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from _jit import jit_apply, jit_init
+
 from frl_distributed_ml_scaffold_tpu.config.schema import (
     GPTConfig,
     MoEConfig,
@@ -31,9 +33,10 @@ def clear_mesh_context():
 
 
 def init_and_forward(model, x, train=False):
-    variables = model.init({"params": jax.random.key(0)}, x, train=False)
+    variables = jit_init(model, x, train=False)
     rngs = {"dropout": jax.random.key(1)} if train else None
-    return variables, model.apply(variables, x, train=train, rngs=rngs)
+    out = jit_apply(model, train=train, rngs=rngs)(variables, x)
+    return variables, out
 
 
 def test_resnet50_forward_and_batchstats():
@@ -43,10 +46,10 @@ def test_resnet50_forward_and_batchstats():
     assert logits.shape == (2, 10)
     assert "batch_stats" in variables
     # train mode mutates batch_stats
-    out, updated = model.apply(
-        variables, x, train=True, mutable=["batch_stats"],
+    out, updated = jit_apply(
+        model, train=True, mutable=["batch_stats"],
         rngs={"dropout": jax.random.key(1)},
-    )
+    )(variables, x)
     leaves_before = jax.tree.leaves(variables["batch_stats"])
     leaves_after = jax.tree.leaves(updated["batch_stats"])
     assert any(
@@ -152,15 +155,17 @@ def test_maxpool_mask_grad_ties_preserve_mass():
 
 def test_resnet_pool_grad_mask_trains():
     model = create_model(
-        ResNetConfig(depth=18, num_classes=7, pool_grad="mask"), FP32
+        ResNetConfig(depth=10, num_classes=7, pool_grad="mask"), FP32
     )
     x = jax.random.normal(jax.random.key(2), (2, 32, 32, 3))
     variables, logits = init_and_forward(model, x)
     assert logits.shape == (2, 7)
-    g = jax.grad(
-        lambda p: model.apply(
-            {**variables, "params": p}, x, train=False
-        ).sum()
+    g = jax.jit(
+        jax.grad(
+            lambda p: model.apply(
+                {**variables, "params": p}, x, train=False
+            ).sum()
+        )
     )(variables["params"])
     assert all(np.isfinite(l).all() for l in jax.tree.leaves(g))
 
@@ -194,9 +199,10 @@ def test_gpt_causality():
     model = create_model(tiny_gpt(), FP32)
     t1 = jnp.zeros((1, 16), jnp.int32)
     t2 = t1.at[0, 10].set(5)
-    variables = model.init({"params": jax.random.key(0)}, t1, train=False)
-    l1 = model.apply(variables, t1, train=False)
-    l2 = model.apply(variables, t2, train=False)
+    variables = jit_init(model, t1, train=False)
+    fwd = jit_apply(model, train=False)
+    l1 = fwd(variables, t1)
+    l2 = fwd(variables, t2)
     np.testing.assert_allclose(l1[0, :10], l2[0, :10], atol=1e-5)
     assert not np.allclose(l1[0, 10:], l2[0, 10:])
 
@@ -206,8 +212,8 @@ def test_gpt_moe_forward_and_aux():
         tiny_gpt(moe=MoEConfig(num_experts=4, top_k=2)), FP32
     )
     tokens = jnp.zeros((2, 16), jnp.int32)
-    variables = model.init({"params": jax.random.key(0)}, tokens, train=False)
-    logits, aux = model.apply(variables, tokens, train=False)
+    variables = jit_init(model, tokens, train=False)
+    logits, aux = jit_apply(model, train=False)(variables, tokens)
     assert logits.shape == (2, 16, 64)
     assert np.isfinite(float(aux)) and float(aux) >= 0
 

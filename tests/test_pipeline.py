@@ -9,6 +9,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from _jit import jit_apply, jit_init
+
 from frl_distributed_ml_scaffold_tpu.config import apply_overrides, get_config
 from frl_distributed_ml_scaffold_tpu.config.schema import GPTConfig, PrecisionConfig
 from frl_distributed_ml_scaffold_tpu.models.gpt import GPT
@@ -66,10 +68,10 @@ def test_circular_pp_matches_plain(stages, repeat, micro):
     )
     tokens = jax.random.randint(jax.random.key(8), (8, 16), 0, 128)
     m_plain, m_c = GPT(base, FP32), GPT(cc, FP32)
-    params = m_plain.init({"params": jax.random.key(0)}, tokens, train=False)["params"]
+    params = jit_init(m_plain, tokens, train=False)["params"]
     cp = plain_to_circular(params, stages, repeat)
-    out_plain = m_plain.apply({"params": params}, tokens, train=False)
-    out_c = m_c.apply({"params": cp}, tokens, train=False)
+    out_plain = jit_apply(m_plain, train=False)({"params": params}, tokens)
+    out_c = jit_apply(m_c, train=False)({"params": cp}, tokens)
     np.testing.assert_allclose(out_plain, out_c, atol=1e-5, rtol=1e-5)
 
     def loss_plain(p):
@@ -78,8 +80,10 @@ def test_circular_pp_matches_plain(stages, repeat, micro):
     def loss_c(p):
         return jnp.mean(m_c.apply({"params": p}, tokens, train=False) ** 2)
 
-    g_plain = plain_to_circular(jax.grad(loss_plain)(params), stages, repeat)
-    g_c = jax.grad(loss_c)(cp)
+    g_plain = plain_to_circular(
+        jax.jit(jax.grad(loss_plain))(params), stages, repeat
+    )
+    g_c = jax.jit(jax.grad(loss_c))(cp)
     jax.tree.map(
         lambda a, b: np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-4),
         g_plain,
@@ -133,10 +137,10 @@ def test_pp_forward_matches_plain():
     pp = dataclasses.replace(base, pipeline_stages=2, pipeline_microbatches=2)
     tokens = jax.random.randint(jax.random.key(1), (4, 16), 0, 128)
     m_plain, m_pp = GPT(base, FP32), GPT(pp, FP32)
-    params = m_plain.init({"params": jax.random.key(0)}, tokens, train=False)["params"]
-    out_plain = m_plain.apply({"params": params}, tokens, train=False)
-    out_pp = m_pp.apply(
-        {"params": plain_to_pipelined(params, 2)}, tokens, train=False
+    params = jit_init(m_plain, tokens, train=False)["params"]
+    out_plain = jit_apply(m_plain, train=False)({"params": params}, tokens)
+    out_pp = jit_apply(m_pp, train=False)(
+        {"params": plain_to_pipelined(params, 2)}, tokens
     )
     np.testing.assert_allclose(out_plain, out_pp, atol=1e-5, rtol=1e-5)
 
@@ -147,7 +151,7 @@ def test_pp_grads_match_plain():
     pp = dataclasses.replace(base, pipeline_stages=2, pipeline_microbatches=2)
     tokens = jax.random.randint(jax.random.key(2), (4, 16), 0, 128)
     m_plain, m_pp = GPT(base, FP32), GPT(pp, FP32)
-    params = m_plain.init({"params": jax.random.key(0)}, tokens, train=False)["params"]
+    params = jit_init(m_plain, tokens, train=False)["params"]
 
     def loss_plain(p):
         return jnp.mean(m_plain.apply({"params": p}, tokens, train=False) ** 2)
@@ -155,8 +159,8 @@ def test_pp_grads_match_plain():
     def loss_pp(p):
         return jnp.mean(m_pp.apply({"params": p}, tokens, train=False) ** 2)
 
-    g_plain = jax.grad(loss_plain)(params)
-    g_pp = jax.grad(loss_pp)(plain_to_pipelined(params, 2))
+    g_plain = jax.jit(jax.grad(loss_plain))(params)
+    g_pp = jax.jit(jax.grad(loss_pp))(plain_to_pipelined(params, 2))
     jax.tree.map(
         lambda a, b: np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-4),
         plain_to_pipelined(g_plain, 2),
@@ -172,10 +176,10 @@ def test_pp_moe_aux_loss_batch_invariant():
     pp = dataclasses.replace(base, pipeline_stages=2, pipeline_microbatches=4)
     tokens = jax.random.randint(jax.random.key(3), (8, 16), 0, 128)
     m_plain, m_pp = GPT(base, FP32), GPT(pp, FP32)
-    params = m_plain.init({"params": jax.random.key(0)}, tokens, train=False)["params"]
-    _, aux_plain = m_plain.apply({"params": params}, tokens, train=False)
-    _, aux_pp = m_pp.apply(
-        {"params": plain_to_pipelined(params, 2)}, tokens, train=False
+    params = jit_init(m_plain, tokens, train=False)["params"]
+    _, aux_plain = jit_apply(m_plain, train=False)({"params": params}, tokens)
+    _, aux_pp = jit_apply(m_pp, train=False)(
+        {"params": plain_to_pipelined(params, 2)}, tokens
     )
     # Microbatch router stats are means over different token subsets, so
     # the two aux values agree only in expectation — assert same scale.
@@ -197,8 +201,8 @@ def test_pp_composes_with_ring_attention():
     )
     tokens = jax.random.randint(jax.random.key(4), (4, 16), 0, 128)
     m_plain, m_pp = GPT(base, FP32), GPT(pp_ring, FP32)
-    params = m_plain.init({"params": jax.random.key(0)}, tokens, train=False)["params"]
-    out_plain = m_plain.apply({"params": params}, tokens, train=False)
+    params = jit_init(m_plain, tokens, train=False)["params"]
+    out_plain = jit_apply(m_plain, train=False)({"params": params}, tokens)
 
     env = build_mesh(MeshConfig(pipe=2, data=2, seq=2))
     with mesh_context(env):
@@ -265,8 +269,8 @@ def test_pp_composes_with_ulysses_attention():
     )
     tokens = jax.random.randint(jax.random.key(5), (4, 16), 0, 128)
     m_plain, m_pp = GPT(base, FP32), GPT(pp_uly, FP32)
-    params = m_plain.init({"params": jax.random.key(0)}, tokens, train=False)["params"]
-    out_plain = m_plain.apply({"params": params}, tokens, train=False)
+    params = jit_init(m_plain, tokens, train=False)["params"]
+    out_plain = jit_apply(m_plain, train=False)({"params": params}, tokens)
 
     env = build_mesh(MeshConfig(pipe=2, data=2, seq=2))
     with mesh_context(env):
@@ -304,8 +308,8 @@ def test_pp_composes_with_flash_attention_pallas(monkeypatch):
     )
     tokens = jax.random.randint(jax.random.key(6), (4, 16), 0, 128)
     m_plain, m_pp = GPT(base, FP32), GPT(pp_flash, FP32)
-    params = m_plain.init({"params": jax.random.key(0)}, tokens, train=False)["params"]
-    out_plain = m_plain.apply({"params": params}, tokens, train=False)
+    params = jit_init(m_plain, tokens, train=False)["params"]
+    out_plain = jit_apply(m_plain, train=False)({"params": params}, tokens)
 
     env = build_mesh(MeshConfig(pipe=2, data=2, model=2))
     with mesh_context(env):
