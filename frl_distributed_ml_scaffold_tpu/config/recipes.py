@@ -236,3 +236,28 @@ def gpt2_pp_circular() -> ExperimentConfig:
         name="gpt2_pp_circular",
         model=dataclasses.replace(base.model, pipeline_circular_repeat=2),
     )
+
+
+@register_config("imagenet_rn101_ddp")
+def imagenet_rn101_ddp() -> ExperimentConfig:
+    """Deeper-variant showcase: ResNet-101 on the RN50 recipe (the torch
+    zoo's standard scale-up; same schedule, depth=101 bottleneck stacks)."""
+    base = imagenet_rn50_ddp()
+    return base.replace(
+        name="imagenet_rn101_ddp",
+        model=dataclasses.replace(base.model, depth=101),
+    )
+
+
+@register_config("imagenet_vitl_fsdp")
+def imagenet_vitl_fsdp() -> ExperimentConfig:
+    """Scale-up showcase: ViT-L/16 (307M params) on the ViT-B FSDP recipe —
+    the config where FSDP sharding and remat stop being optional on small
+    slices."""
+    base = imagenet_vitb_fsdp()
+    return base.replace(
+        name="imagenet_vitl_fsdp",
+        model=dataclasses.replace(
+            base.model, hidden_dim=1024, num_layers=24, num_heads=16
+        ),
+    )

@@ -39,7 +39,16 @@ def make_classification_loss(model, input_key: str = "image"):
         labels = batch["label"]
         loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
         acc = (jnp.argmax(logits, -1) == labels).astype(jnp.float32).mean()
-        return loss, ({"accuracy": acc}, new_extras)
+        metrics = {"accuracy": acc}
+        if logits.shape[-1] > 5:
+            # Top-5: the standard ImageNet companion metric. top_k on the
+            # MXU-unfriendly class dim is cheap relative to the step and
+            # only runs when there are more than 5 classes to rank.
+            _, top5 = jax.lax.top_k(logits, 5)
+            metrics["accuracy_top5"] = (
+                (top5 == labels[..., None]).any(-1).astype(jnp.float32).mean()
+            )
+        return loss, (metrics, new_extras)
 
     return loss_fn
 

@@ -36,6 +36,8 @@ def test_mnist_mlp_learns(tmp_path):
 
     assert losses[-1] < losses[0] * 0.5, f"loss did not halve: {losses[0]} -> {losses[-1]}"
     assert float(metrics["accuracy"]) > 0.8
+    # Top-5 (10 classes here) must dominate top-1 by construction.
+    assert float(metrics["accuracy_top5"]) >= float(metrics["accuracy"])
 
 
 def test_mnist_fit_loop_and_eval(tmp_path):
