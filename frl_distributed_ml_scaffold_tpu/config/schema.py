@@ -244,6 +244,10 @@ class GPTConfig:
     seq_len: int = 1024
     mlp_ratio: int = 4
     dropout: float = 0.0
+    # GPT-2's LayerNorm epsilon (flax's default is 1e-6; HF checkpoints
+    # are trained with 1e-5 — keeping it makes HF imports numerically
+    # exact, see tools/import_hf_gpt2.py).
+    layer_norm_epsilon: float = 1e-5
     # Attention implementation: "dense" | "ring" | "ulysses" | "flash"
     attention: str = "dense"
     # Chunked-vocab LM loss: compute the weight-tied head + cross-entropy

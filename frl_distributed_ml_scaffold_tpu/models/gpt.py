@@ -192,11 +192,11 @@ class Block(nn.Module):
     def __call__(self, carry, _unused):
         x, aux_loss = carry
         cfg, train = self.config, self.train
-        y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        y = nn.LayerNorm(dtype=jnp.float32, epsilon=cfg.layer_norm_epsilon, name="ln1")(x)
         x = x + CausalSelfAttention(cfg, self.dtype, name="attn")(
             y, train=train, decode=self.decode
         )
-        y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        y = nn.LayerNorm(dtype=jnp.float32, epsilon=cfg.layer_norm_epsilon, name="ln2")(x)
         if cfg.moe.num_experts > 0:
             from frl_distributed_ml_scaffold_tpu.models.moe import MoEMlp
 
@@ -289,7 +289,7 @@ class GPT(nn.Module):
             )(cfg, dtype, train, decode, name="blocks")
             (x, aux_loss), _ = blocks((x, jnp.zeros((), jnp.float32)), None)
 
-        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        x = nn.LayerNorm(dtype=jnp.float32, epsilon=cfg.layer_norm_epsilon, name="ln_f")(x)
         if return_features:
             # Pre-head features for the chunked-vocab LM loss (the weight-
             # tied head lives at params['wte']['embedding']; the loss

@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""Import Hugging Face GPT-2 weights into this framework's GPT params.
+
+Interop path for users migrating from the torch ecosystem: any HF GPT-2
+checkpoint (`GPT2LMHeadModel` / `GPT2Model`, any size) converts into the
+exact pytree `models/gpt.py` trains — weight-tied head, scanned blocks with
+a leading layer dim — ready for fine-tuning or `models/generation.py`
+decoding. Architecture notes that make the mapping exact:
+
+- HF's Conv1D stores weights as ``[in_features, out_features]`` — already
+  flax Dense ``kernel`` layout, no transpose.
+- HF fuses q/k/v into ``c_attn`` ``[D, 3D]``; split on the last axis.
+- Both use tanh-approximate GeLU, tie ``lm_head`` to ``wte``, and (since
+  GPTConfig.layer_norm_epsilon mirrors HF's) share LayerNorm numerics —
+  converted logits match HF's forward to float-summation-order tolerance
+  (tests/test_hf_import.py).
+
+Usage (offline — point at a local checkpoint directory):
+
+    python tools/import_hf_gpt2.py --hf-dir /path/to/gpt2-medium \
+        --out /tmp/gpt2_medium_params.msgpack
+    python launch.py --config=gpt2_medium_zero1 ...   # then restore, or
+    # load in code: params = load_params("/tmp/gpt2_medium_params.msgpack")
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def hf_gpt2_to_params(hf_model) -> dict:
+    """Convert an HF GPT2 (LMHead)Model to the frl GPT params pytree."""
+    sd = {k: v.detach().cpu().numpy() for k, v in hf_model.state_dict().items()}
+    pre = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+    n_layer = 1 + max(
+        int(k.split(".")[1 if not pre else 2])
+        for k in sd
+        if k.startswith(f"{pre}h.")
+    )
+
+    def stack(fmt: str) -> np.ndarray:
+        return np.stack([sd[f"{pre}{fmt.format(i)}"] for i in range(n_layer)])
+
+    c_attn_w = stack("h.{}.attn.c_attn.weight")  # [L, D, 3D], Dense layout
+    c_attn_b = stack("h.{}.attn.c_attn.bias")  # [L, 3D]
+    q_w, k_w, v_w = np.split(c_attn_w, 3, axis=2)
+    q_b, k_b, v_b = np.split(c_attn_b, 3, axis=1)
+
+    def dense(w, b):
+        return {"kernel": w, "bias": b}
+
+    def ln(fmt: str):
+        return {"scale": stack(fmt + ".weight"), "bias": stack(fmt + ".bias")}
+
+    return {
+        "wte": {"embedding": sd[f"{pre}wte.weight"]},
+        "wpe": sd[f"{pre}wpe.weight"],
+        "blocks": {
+            "ln1": ln("h.{}.ln_1"),
+            "attn": {
+                "query": dense(q_w, q_b),
+                "key": dense(k_w, k_b),
+                "value": dense(v_w, v_b),
+                "out": dense(
+                    stack("h.{}.attn.c_proj.weight"),
+                    stack("h.{}.attn.c_proj.bias"),
+                ),
+            },
+            "ln2": ln("h.{}.ln_2"),
+            "mlp": {
+                "fc_in": dense(
+                    stack("h.{}.mlp.c_fc.weight"), stack("h.{}.mlp.c_fc.bias")
+                ),
+                "fc_out": dense(
+                    stack("h.{}.mlp.c_proj.weight"),
+                    stack("h.{}.mlp.c_proj.bias"),
+                ),
+            },
+        },
+        "ln_f": {
+            "scale": sd[f"{pre}ln_f.weight"],
+            "bias": sd[f"{pre}ln_f.bias"],
+        },
+    }
+
+
+def gpt_config_from_hf(hf_config):
+    """The matching GPTConfig for a converted checkpoint."""
+    from frl_distributed_ml_scaffold_tpu.config.schema import GPTConfig
+
+    n_inner = getattr(hf_config, "n_inner", None)
+    if n_inner is not None and n_inner != 4 * hf_config.n_embd:
+        # GPTConfig expresses the MLP width as an integer ratio.
+        if n_inner % hf_config.n_embd:
+            raise ValueError(
+                f"HF n_inner={n_inner} is not an integer multiple of "
+                f"n_embd={hf_config.n_embd}; GPTConfig.mlp_ratio cannot "
+                "express this checkpoint"
+            )
+    ratio = (n_inner // hf_config.n_embd) if n_inner else 4
+    return GPTConfig(
+        vocab_size=hf_config.vocab_size,
+        num_layers=hf_config.n_layer,
+        num_heads=hf_config.n_head,
+        hidden_dim=hf_config.n_embd,
+        seq_len=hf_config.n_positions,
+        mlp_ratio=ratio,
+        dropout=0.0,
+        layer_norm_epsilon=float(
+            getattr(hf_config, "layer_norm_epsilon", 1e-5)
+        ),
+    )
+
+
+def save_params(params: dict, path: str) -> None:
+    from flax import serialization
+
+    with open(path, "wb") as fh:
+        fh.write(serialization.to_bytes(params))
+
+
+def load_params(path: str) -> dict:
+    """Inverse of save_params: byte-exact params pytree (numpy leaves)."""
+    from flax import serialization
+
+    with open(path, "rb") as fh:
+        return serialization.msgpack_restore(fh.read())
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--hf-dir", required=True,
+                    help="local HF checkpoint directory (no network fetch)")
+    ap.add_argument("--out", required=True, help="output .msgpack path")
+    args = ap.parse_args()
+
+    from transformers import GPT2LMHeadModel
+
+    hf = GPT2LMHeadModel.from_pretrained(args.hf_dir)
+    params = hf_gpt2_to_params(hf)
+    cfg = gpt_config_from_hf(hf.config)
+    save_params(params, args.out)
+    n = sum(int(np.prod(x.shape)) for x in
+            __import__("jax").tree.leaves(params))
+    print(f"wrote {args.out}: {n/1e6:.1f}M params, config {cfg}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
