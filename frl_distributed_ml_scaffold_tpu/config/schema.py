@@ -133,6 +133,13 @@ class TrainerConfig:
     # the EMA weights (the reason to keep them) and they ride the same
     # sharding specs + checkpoint as the live params.
     ema_decay: float = 0.0
+    # Keep the optimizer state in host memory (``pinned_host``): XLA
+    # streams it through HBM around the update. A CAPACITY knob, not a
+    # speed knob — it pays PCIe traffic every optimizer step to free
+    # state-sized HBM (e.g. GPT-2-medium's ~4.3G AdamW fp32 state).
+    # TPU-only: the CPU sim backend cannot partition host-memory arrays
+    # (the Trainer refuses with a clear error).
+    offload_opt_state: bool = False
 
 
 @dataclass(frozen=True)

@@ -162,6 +162,25 @@ class Trainer:
             ema_params=p_specs if state_shapes.ema_params is not None else None,
         )
         self.state_shardings = shardings_from_specs(self.state_specs, env.mesh)
+        if cfg.trainer.offload_opt_state:
+            dev0 = env.mesh.devices.flat[0]
+            kinds = {m.kind for m in dev0.addressable_memories()}
+            # The CPU backend LISTS pinned_host but its SPMD partitioner
+            # cannot place arrays there (RET_CHECK crash) — refuse by
+            # platform, not just by advertised memory kinds.
+            if dev0.platform == "cpu" or "pinned_host" not in kinds:
+                raise ValueError(
+                    "trainer.offload_opt_state=true is a TPU capacity "
+                    f"feature (platform={dev0.platform!r}, memory kinds "
+                    f"{sorted(kinds)}); the CPU sim cannot partition "
+                    "host-memory arrays — see docs/perf_playbook.md"
+                )
+            self.state_shardings = self.state_shardings.replace(
+                opt_state=jax.tree.map(
+                    lambda s: s.with_memory_kind("pinned_host"),
+                    self.state_shardings.opt_state,
+                )
+            )
         self.state_shapes = state_shapes
         self._rng = rng
 
@@ -219,6 +238,7 @@ class Trainer:
             grad_accum=cfg.trainer.grad_accum,
             remat=cfg.trainer.remat,
             ema_decay=cfg.trainer.ema_decay,
+            offload_opt_state=cfg.trainer.offload_opt_state,
         )
         # Batch shardings are inferred from the example batch structure.
         example = example_input(cfg.data, cfg.model, batch_size=self.env.batch_axis_size)

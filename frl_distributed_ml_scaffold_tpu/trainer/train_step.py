@@ -62,6 +62,7 @@ def make_train_step(
     grad_accum: int = 1,
     remat: str = "none",
     ema_decay: float = 0.0,
+    offload_opt_state: bool = False,
 ) -> Callable[[TrainState, Any], tuple[TrainState, dict[str, jax.Array]]]:
     """Build the (unjitted) step function; the Trainer jits it with shardings.
 
@@ -134,7 +135,18 @@ def make_train_step(
                 params_c, state.extras, batch, rng
             )
         grads = policy.cast_to_param(grads)
-        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        opt_state = state.opt_state
+        if offload_opt_state:
+            # Host-offloaded optimizer state (trainer.offload_opt_state):
+            # stream it into HBM for the update, write it back out. The
+            # explicit space moves keep the update math on-device; XLA
+            # schedules the copies around the backward.
+            import jax.memory as jm
+
+            opt_state = jax.device_put(opt_state, jm.Space.Device)
+        updates, new_opt_state = tx.update(grads, opt_state, state.params)
+        if offload_opt_state:
+            new_opt_state = jax.device_put(new_opt_state, jm.Space.Host)
         new_params = optax.apply_updates(state.params, updates)
         out_metrics = dict(metrics)
         out_metrics["loss"] = loss.astype(jnp.float32)

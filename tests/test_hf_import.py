@@ -16,6 +16,7 @@ sys.path.insert(
 from _jit import jit_apply
 
 transformers = pytest.importorskip("transformers")
+pytest.importorskip("torch")
 
 
 @pytest.fixture(scope="module")

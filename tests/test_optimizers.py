@@ -88,3 +88,23 @@ def test_lion_composes_with_zero1_sharding():
     assert sharded, "zero1 left every lion moment leaf replicated"
     state, m = t.train_step(state, t.pipeline.global_batch(0))
     assert np.isfinite(float(m["loss"]))
+
+
+def test_offload_opt_state_refuses_backend_without_pinned_host(tmp_path):
+    """trainer.offload_opt_state is a TPU capacity feature; on the CPU sim
+    (no pinned_host memory) the Trainer must refuse with a clear error
+    instead of the partitioner's opaque RET_CHECK failure."""
+    import pytest
+
+    from frl_distributed_ml_scaffold_tpu.config import (
+        apply_overrides,
+        get_config,
+    )
+    from frl_distributed_ml_scaffold_tpu.trainer.loop import Trainer
+
+    cfg = apply_overrides(
+        get_config("mnist_mlp"),
+        ["trainer.offload_opt_state=true", f"workdir={tmp_path}"],
+    )
+    with pytest.raises(ValueError, match="pinned_host"):
+        Trainer(cfg)

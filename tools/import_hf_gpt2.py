@@ -94,6 +94,22 @@ def gpt_config_from_hf(hf_config):
     """The matching GPTConfig for a converted checkpoint."""
     from frl_distributed_ml_scaffold_tpu.config.schema import GPTConfig
 
+    act = getattr(hf_config, "activation_function", "gelu_new")
+    unsupported = {
+        "activation_function != gelu_new": act != "gelu_new",
+        "scale_attn_by_inverse_layer_idx": bool(
+            getattr(hf_config, "scale_attn_by_inverse_layer_idx", False)
+        ),
+        "reorder_and_upcast_attn": bool(
+            getattr(hf_config, "reorder_and_upcast_attn", False)
+        ),
+    }
+    bad = [k for k, v in unsupported.items() if v]
+    if bad:
+        raise ValueError(
+            f"HF config uses variants this GPT cannot reproduce: {bad}; "
+            "converting would produce silently wrong logits"
+        )
     n_inner = getattr(hf_config, "n_inner", None)
     if n_inner is not None and n_inner != 4 * hf_config.n_embd:
         # GPTConfig expresses the MLP width as an integer ratio.

@@ -124,6 +124,30 @@ def main() -> int:
     failures += not ok
     emit("mnist_learns_on_chip", ok, losses=[round(l, 4) for l in losses])
 
+    # --- optimizer-state host offload (pinned_host is TPU-only) ----------
+    cfg = apply_overrides(
+        get_config("mnist_mlp"),
+        ["data.global_batch_size=256", "data.prefetch=0",
+         "trainer.log_every=1000000", "checkpoint.enabled=false",
+         "trainer.offload_opt_state=true", "workdir=/tmp/frl_tpu_smoke"],
+    )
+    trainer = Trainer(cfg)
+    state = trainer.init_state()
+    kinds = sorted(
+        {l.sharding.memory_kind for l in jax.tree.leaves(state.opt_state)}
+    )
+    batch = trainer.pipeline.global_batch(0)
+    l0 = None
+    for step in range(20):
+        state, metrics = trainer.train_step(state, batch)
+        if step == 0:
+            l0 = float(jax.device_get(metrics["loss"]))
+    l_last = float(jax.device_get(metrics["loss"]))
+    ok = kinds == ["pinned_host"] and l_last < l0
+    failures += not ok
+    emit("opt_state_offload_on_chip", ok, memory_kinds=kinds,
+         loss0=round(l0, 4), loss_last=round(l_last, 4))
+
     emit("summary", failures == 0, failures=failures)
     return 1 if failures else 0
 
