@@ -133,6 +133,10 @@ class TrainerConfig:
     # the EMA weights (the reason to keep them) and they ride the same
     # sharding specs + checkpoint as the live params.
     ema_decay: float = 0.0
+    # Write metric scalars to TensorBoard (<workdir>/<name>/tb) next to
+    # the profiler traces. JSONL remains the record of truth; the sink is
+    # lazy-TF and degrades to a warning if TF is unusable.
+    tensorboard: bool = False
     # Keep the optimizer state in host memory (``pinned_host``): XLA
     # streams it through HBM around the update. A CAPACITY knob, not a
     # speed knob — it pays PCIe traffic every optimizer step to free

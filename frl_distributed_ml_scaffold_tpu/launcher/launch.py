@@ -126,6 +126,37 @@ def run_experiment(cfg, *, check_imports: bool = True):
     return trainer.fit(on_step=fault_hook_from_env(cfg))
 
 
+_BANNED_IMPORT_PREFIXES = ("torch", "cupy", "nccl")
+
+
+def _imported_names(tree) -> "list[str]":
+    """Every module name a parsed source imports: Import/ImportFrom plus
+    the dynamic forms ``importlib.import_module("x")`` / ``__import__("x")``
+    with literal arguments. Module-level so tests can pin the semantics."""
+    import ast
+
+    names: list[str] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            names.extend(a.name for a in node.names)
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            names.append(node.module)
+        elif (
+            isinstance(node, ast.Call)
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+            and (
+                (isinstance(node.func, ast.Attribute)
+                 and node.func.attr == "import_module")
+                or (isinstance(node.func, ast.Name)
+                    and node.func.id == "__import__")
+            )
+        ):
+            names.append(node.args[0].value)
+    return names
+
+
 def _assert_no_cuda_imports() -> None:
     """The north-star constraint: zero CUDA/NCCL imports in the TPU path.
 
@@ -137,28 +168,6 @@ def _assert_no_cuda_imports() -> None:
     """
     import ast
 
-    banned = ("torch", "cupy", "nccl")
-
-    def _bad_names(tree: ast.AST):
-        for node in ast.walk(tree):
-            if isinstance(node, ast.Import):
-                yield from (a.name for a in node.names)
-            elif isinstance(node, ast.ImportFrom) and node.module:
-                yield node.module
-            elif (  # importlib.import_module("torch") / __import__("torch")
-                isinstance(node, ast.Call)
-                and node.args
-                and isinstance(node.args[0], ast.Constant)
-                and isinstance(node.args[0].value, str)
-                and (
-                    (isinstance(node.func, ast.Attribute)
-                     and node.func.attr == "import_module")
-                    or (isinstance(node.func, ast.Name)
-                        and node.func.id == "__import__")
-                )
-            ):
-                yield node.args[0].value
-
     pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     offenders = []
     for dirpath, _, files in os.walk(pkg_root):
@@ -166,14 +175,22 @@ def _assert_no_cuda_imports() -> None:
             if not f.endswith(".py"):
                 continue
             path = os.path.join(dirpath, f)
-            with open(path, encoding="utf-8") as fh:
-                tree = ast.parse(fh.read(), filename=path)
+            rel = os.path.relpath(path, pkg_root)
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    tree = ast.parse(fh.read(), filename=path)
+            except (SyntaxError, UnicodeDecodeError) as e:
+                # A .py the interpreter could never import can't be
+                # cleared by the scan — flag it with its parse error
+                # rather than crashing the launch with a raw traceback.
+                offenders.append(f"{rel} (unparseable: {e})")
+                continue
             if any(
                 n == b or n.startswith(b + ".")
-                for n in _bad_names(tree)
-                for b in banned
+                for n in _imported_names(tree)
+                for b in _BANNED_IMPORT_PREFIXES
             ):
-                offenders.append(os.path.relpath(path, pkg_root))
+                offenders.append(rel)
     if offenders:
         raise RuntimeError(
             f"CUDA-path imports in TPU scaffold sources: {offenders}"

@@ -163,7 +163,14 @@ class Trainer:
         )
         self.state_shardings = shardings_from_specs(self.state_specs, env.mesh)
         if cfg.trainer.offload_opt_state:
-            dev0 = env.mesh.devices.flat[0]
+            # Probe a device THIS process owns: on a multi-host mesh,
+            # devices.flat[0] belongs to process 0 and its
+            # addressable_memories() is not queryable from other hosts.
+            dev0 = next(
+                (d for d in env.mesh.devices.flat
+                 if d.process_index == jax.process_index()),
+                env.mesh.devices.flat[0],
+            )
             kinds = {m.kind for m in dev0.addressable_memories()}
             # The CPU backend LISTS pinned_host but its SPMD partitioner
             # cannot place arrays there (RET_CHECK crash) — refuse by
@@ -323,7 +330,12 @@ class Trainer:
         start_step = int(jax.device_get(state.step))
 
         metric_logger = MetricLogger(
-            os.path.join(cfg.workdir, cfg.name, "metrics.jsonl")
+            os.path.join(cfg.workdir, cfg.name, "metrics.jsonl"),
+            tb_dir=(
+                os.path.join(cfg.workdir, cfg.name, "tb")
+                if cfg.trainer.tensorboard
+                else None
+            ),
         )
         timer = StepTimer(warmup=1)  # first window contains compile
         samples_per_step = cfg.data.global_batch_size

@@ -105,6 +105,42 @@ def _json_default(x: Any) -> Any:
     return str(x)
 
 
+class TensorBoardWriter:
+    """Optional TensorBoard scalar sink (``tf.summary``), primary-only.
+
+    TensorFlow is imported lazily and failures downgrade to a warning —
+    the sink is observability sugar on top of the JSONL record of truth,
+    never a dependency of the training path. (jax.profiler traces already
+    land in TensorBoard; this adds the scalar curves next to them.)
+    """
+
+    def __init__(self, logdir: str | None):
+        self._writer = None
+        self._tf = None
+        if logdir and is_primary_process():
+            try:
+                import tensorflow as tf
+
+                self._tf = tf
+                self._writer = tf.summary.create_file_writer(logdir)
+            except Exception as e:  # missing/broken TF: sink off, run on
+                get_logger().warning("tensorboard sink disabled: %s", e)
+
+    def write(self, step: int, record: Mapping[str, Any]) -> None:
+        if self._writer is None:
+            return
+        with self._writer.as_default(step=int(step)):
+            for k, v in record.items():
+                if k != "step" and isinstance(v, (int, float)):
+                    self._tf.summary.scalar(k, float(v))
+        self._writer.flush()
+
+    def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+
+
 class MetricLogger:
     """Periodic metric emitter: stdout line + JSONL record.
 
@@ -112,9 +148,15 @@ class MetricLogger:
     (one ``device_get`` for the whole dict) and writes both sinks.
     """
 
-    def __init__(self, jsonl_path: str | None = None, name: str = "frl_tpu"):
+    def __init__(
+        self,
+        jsonl_path: str | None = None,
+        name: str = "frl_tpu",
+        tb_dir: str | None = None,
+    ):
         self._logger = get_logger(name)
         self._jsonl = JsonlWriter(jsonl_path)
+        self._tb = TensorBoardWriter(tb_dir)
         self._start = time.monotonic()
 
     def log(
@@ -143,7 +185,9 @@ class MetricLogger:
         ]
         self._logger.info(" ".join(parts))
         self._jsonl.write(record)
+        self._tb.write(record["step"], record)
         return record
 
     def close(self) -> None:
         self._jsonl.close()
+        self._tb.close()

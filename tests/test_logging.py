@@ -26,3 +26,29 @@ def test_is_primary_process_initializes_no_backend():
     )
     assert r.returncode == 0, r.stdout + r.stderr
     assert "NO_BACKEND_OK" in r.stdout
+
+
+def test_tensorboard_sink_writes_event_file(tmp_path):
+    """trainer.tensorboard=true writes TB scalar events next to the JSONL
+    (lazy TF import; JSONL stays the record of truth)."""
+    import glob
+
+    import pytest
+
+    pytest.importorskip("tensorflow")  # the sink degrades without TF
+    from frl_distributed_ml_scaffold_tpu.config import (
+        apply_overrides,
+        get_config,
+    )
+    from frl_distributed_ml_scaffold_tpu.trainer.loop import Trainer
+
+    cfg = apply_overrides(
+        get_config("mnist_mlp"),
+        ["trainer.total_steps=4", "trainer.log_every=2",
+         "trainer.tensorboard=true", "data.global_batch_size=16",
+         "model.hidden_sizes=16", "checkpoint.enabled=false",
+         f"workdir={tmp_path}"],
+    )
+    Trainer(cfg).fit()
+    events = glob.glob(str(tmp_path / "mnist_mlp" / "tb" / "events.*"))
+    assert events, "no TensorBoard event file written"
