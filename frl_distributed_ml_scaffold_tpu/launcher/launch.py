@@ -170,6 +170,7 @@ def _assert_no_cuda_imports() -> None:
 
     pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     offenders = []
+    unparseable = []
     for dirpath, _, files in os.walk(pkg_root):
         for f in files:
             if not f.endswith(".py"):
@@ -181,9 +182,9 @@ def _assert_no_cuda_imports() -> None:
                     tree = ast.parse(fh.read(), filename=path)
             except (SyntaxError, UnicodeDecodeError) as e:
                 # A .py the interpreter could never import can't be
-                # cleared by the scan — flag it with its parse error
-                # rather than crashing the launch with a raw traceback.
-                offenders.append(f"{rel} (unparseable: {e})")
+                # cleared by the scan — report it as what it is (a broken
+                # source file), not as a CUDA dependency.
+                unparseable.append(f"{rel}: {e}")
                 continue
             if any(
                 n == b or n.startswith(b + ".")
@@ -191,6 +192,11 @@ def _assert_no_cuda_imports() -> None:
                 for b in _BANNED_IMPORT_PREFIXES
             ):
                 offenders.append(rel)
+    if unparseable:
+        raise RuntimeError(
+            "unparseable .py files in the scaffold package (the no-CUDA "
+            f"scan cannot clear them): {unparseable}"
+        )
     if offenders:
         raise RuntimeError(
             f"CUDA-path imports in TPU scaffold sources: {offenders}"

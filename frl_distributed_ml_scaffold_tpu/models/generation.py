@@ -8,8 +8,9 @@ iteration — static shapes throughout (the cache is pre-sized to
 ``config.seq_len``), so the entire generate call is two XLA programs no
 matter how many tokens are produced.
 
-Sampling: greedy (``temperature=0``), temperature, and top-k — all pure
-functions of the passed rng key, so generation is reproducible.
+Sampling: greedy (``temperature=0``), temperature, top-k, and nucleus
+(top-p) — all pure functions of the passed rng key, so generation is
+reproducible.
 """
 
 from __future__ import annotations
@@ -20,7 +21,10 @@ import jax
 import jax.numpy as jnp
 
 
-def _sample(logits: jax.Array, rng, *, temperature: float, top_k: int):
+def _sample(
+    logits: jax.Array, rng, *, temperature: float, top_k: int,
+    top_p: float = 0.0,
+):
     """[B, V] logits -> [B] sampled token ids (fp32 for stable softmax)."""
     logits = logits.astype(jnp.float32)
     if temperature == 0.0:
@@ -29,6 +33,23 @@ def _sample(logits: jax.Array, rng, *, temperature: float, top_k: int):
     if top_k > 0 and top_k < logits.shape[-1]:  # k >= V keeps everything
         kth = jax.lax.top_k(logits, top_k)[0][:, -1:]  # O(V) threshold
         logits = jnp.where(logits < kth, jnp.finfo(jnp.float32).min, logits)
+    if 0.0 < top_p < 1.0:
+        # Nucleus: keep the smallest prefix of the sorted distribution
+        # whose mass reaches p (the token crossing the threshold is kept —
+        # the standard inclusive nucleus). The keep mask is scattered back
+        # by POSITION, not compared by logit value: value thresholding
+        # would keep every token tied with the boundary logit, silently
+        # disabling the filter on uniform/tied distributions.
+        b = logits.shape[0]
+        order = jnp.argsort(logits, axis=-1)[:, ::-1]  # descending, stable
+        sorted_logits = jnp.take_along_axis(logits, order, axis=-1)
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        mass_before = jnp.cumsum(probs, axis=-1) - probs
+        keep_sorted = mass_before < top_p  # always keeps the top token
+        keep = jnp.zeros(logits.shape, bool).at[
+            jnp.arange(b)[:, None], order
+        ].set(keep_sorted)
+        logits = jnp.where(keep, logits, jnp.finfo(jnp.float32).min)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
@@ -40,6 +61,7 @@ def generate(
     max_new_tokens: int,
     temperature: float = 1.0,
     top_k: int = 0,
+    top_p: float = 0.0,
     eos_id: int | None = None,
     rng: jax.Array | None = None,
 ) -> jax.Array:
@@ -47,7 +69,7 @@ def generate(
 
     Returns [B, Tp + max_new_tokens]; positions after an ``eos_id`` emission
     (when given) are padded with ``eos_id``. Jit-compatible as long as
-    ``max_new_tokens``/``temperature``/``top_k`` stay static — wrap with
+    ``max_new_tokens``/``temperature``/``top_k``/``top_p`` stay static — wrap with
     ``jax.jit(partial(generate, model, ...), static_argnames=...)`` or just
     call it; the two inner ``apply`` calls are where the time goes.
     """
@@ -70,7 +92,8 @@ def generate(
         logits = logits[0]
     cache = vars_out["cache"]
     rng, sub = jax.random.split(rng)
-    tok = _sample(logits[:, -1], sub, temperature=temperature, top_k=top_k)
+    tok = _sample(logits[:, -1], sub, temperature=temperature,
+                  top_k=top_k, top_p=top_p)
     done = jnp.zeros((b,), bool) if eos_id is None else tok == eos_id
 
     def step(carry, _):
@@ -84,7 +107,8 @@ def generate(
         if isinstance(logits, tuple):
             logits = logits[0]
         rng, sub = jax.random.split(rng)
-        nxt = _sample(logits[:, 0], sub, temperature=temperature, top_k=top_k)
+        nxt = _sample(logits[:, 0], sub, temperature=temperature,
+                      top_k=top_k, top_p=top_p)
         if eos_id is not None:
             nxt = jnp.where(done, eos_id, nxt)
             done = done | (nxt == eos_id)

@@ -109,3 +109,39 @@ def test_eos_padding(gpt):
     # The first generated token IS the eos id for row 0, so every later
     # position in row 0 must repeat it.
     assert np.all(np.asarray(out[0, 8:]) == out[0, 8])
+
+
+def test_top_p_sampling_restricts_support(gpt):
+    """Nucleus sampling with a tiny p must only ever emit the argmax when
+    one token dominates the distribution — and stays a pure function of
+    the rng key."""
+    from frl_distributed_ml_scaffold_tpu.models.generation import _sample
+
+    # Row 0: one dominant token; row 1: fully tied (the case where a
+    # value-threshold nucleus would silently keep everything — the mask is
+    # positional, so exactly ceil-to-p of the stable sort order survives).
+    logits = jnp.stack(
+        [
+            jnp.array([10.0, 0.0, 0.0, 0.0]),
+            jnp.zeros((4,)),
+        ]
+    )
+    for seed in range(8):
+        tok = _sample(
+            logits, jax.random.key(seed), temperature=1.0, top_k=0,
+            top_p=0.5,
+        )
+        assert int(tok[0]) == 0  # dominant token holds >0.99 mass
+        # Uniform row: mass_before < 0.5 keeps exactly 2 of 4; descending
+        # order comes from reversing a stable ascending argsort, so the
+        # tied survivors are the highest indices (3, then 2).
+        assert int(tok[1]) in (2, 3)
+    a = generate(
+        *gpt[:2], gpt[2], max_new_tokens=4, temperature=0.9, top_p=0.8,
+        rng=jax.random.key(3),
+    )
+    b = generate(
+        *gpt[:2], gpt[2], max_new_tokens=4, temperature=0.9, top_p=0.8,
+        rng=jax.random.key(3),
+    )
+    np.testing.assert_array_equal(a, b)

@@ -30,6 +30,8 @@ def main() -> int:
     ap.add_argument("--max-new", type=int, default=32)
     ap.add_argument("--temperature", type=float, default=0.8)
     ap.add_argument("--top-k", type=int, default=40)
+    ap.add_argument("--top-p", type=float, default=0.0,
+                    help="nucleus sampling mass (0 = off)")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
 
@@ -77,6 +79,7 @@ def main() -> int:
         max_new_tokens=args.max_new,
         temperature=args.temperature,
         top_k=args.top_k,
+        top_p=args.top_p,
         rng=jax.random.key(args.seed + 1),
     )
     out = jax.device_get(out)
