@@ -78,3 +78,25 @@ def test_save_load_roundtrip(tmp_path, hf_pair):
     jax.tree.map(
         lambda a, b: np.testing.assert_array_equal(a, b), params, restored
     )
+
+
+def test_export_roundtrip_matches(hf_pair):
+    """params -> HF export -> re-import must be byte-identical, and the
+    exported HF model's logits must match the original HF model's."""
+    import torch
+
+    from import_hf_gpt2 import hf_gpt2_to_params, params_to_hf_gpt2
+
+    hf, params, cfg = hf_pair
+    fresh = transformers.GPT2LMHeadModel(hf.config).eval()
+    params_to_hf_gpt2(params, fresh)
+    back = hf_gpt2_to_params(fresh)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(a, b), params, back
+    )
+    tokens = torch.arange(10).reshape(1, 10) % 64
+    with torch.no_grad():
+        np.testing.assert_allclose(
+            fresh(tokens).logits.numpy(), hf(tokens).logits.numpy(),
+            atol=1e-6, rtol=1e-6,
+        )

@@ -5,7 +5,9 @@ Interop path for users migrating from the torch ecosystem: any HF GPT-2
 checkpoint (`GPT2LMHeadModel` / `GPT2Model`, any size) converts into the
 exact pytree `models/gpt.py` trains — weight-tied head, scanned blocks with
 a leading layer dim — ready for fine-tuning or `models/generation.py`
-decoding. Architecture notes that make the mapping exact:
+decoding. The reverse direction (`params_to_hf_gpt2`) loads trained params
+back into an HF model for publishing (round-trip is byte-exact, tested).
+Architecture notes that make the mapping exact:
 
 - HF's Conv1D stores weights as ``[in_features, out_features]`` — already
   flax Dense ``kernel`` layout, no transpose.
@@ -88,6 +90,72 @@ def hf_gpt2_to_params(hf_model) -> dict:
             "bias": sd[f"{pre}ln_f.bias"],
         },
     }
+
+
+def params_to_hf_gpt2(params: dict, hf_model):
+    """Inverse of hf_gpt2_to_params: load this framework's GPT params into
+    an HF GPT2 (LMHead)Model IN PLACE (fine-tune here, publish there).
+    The target model supplies the config; shapes must match."""
+    import torch
+
+    sd = hf_model.state_dict()
+    pre = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+    blocks = params["blocks"]
+    n_layer = int(np.asarray(blocks["ln1"]["scale"]).shape[0])
+    if n_layer != hf_model.config.n_layer:
+        raise ValueError(
+            f"params carry {n_layer} layers but the target HF model is "
+            f"configured for {hf_model.config.n_layer}; a partial load "
+            "would silently leave the extra layers randomly initialized"
+        )
+
+    def put(key: str, value) -> None:
+        # float32 intermediary: torch.from_numpy cannot read ml_dtypes
+        # bfloat16 arrays (bf16-trained params); load_state_dict casts to
+        # the target parameter dtype on copy.
+        arr = torch.from_numpy(
+            np.ascontiguousarray(np.asarray(value).astype(np.float32))
+        )
+        if sd[key].shape != arr.shape:
+            raise ValueError(
+                f"shape mismatch for {key}: HF {tuple(sd[key].shape)} vs "
+                f"converted {tuple(arr.shape)}"
+            )
+        sd[key] = arr
+
+    put(f"{pre}wte.weight", params["wte"]["embedding"])
+    put(f"{pre}wpe.weight", params["wpe"])
+    attn, mlp = blocks["attn"], blocks["mlp"]
+    for i in range(n_layer):
+        put(f"{pre}h.{i}.ln_1.weight", blocks["ln1"]["scale"][i])
+        put(f"{pre}h.{i}.ln_1.bias", blocks["ln1"]["bias"][i])
+        put(
+            f"{pre}h.{i}.attn.c_attn.weight",
+            np.concatenate(
+                [np.asarray(attn[k]["kernel"][i]) for k in ("query", "key", "value")],
+                axis=1,
+            ),
+        )
+        put(
+            f"{pre}h.{i}.attn.c_attn.bias",
+            np.concatenate(
+                [np.asarray(attn[k]["bias"][i]) for k in ("query", "key", "value")]
+            ),
+        )
+        put(f"{pre}h.{i}.attn.c_proj.weight", attn["out"]["kernel"][i])
+        put(f"{pre}h.{i}.attn.c_proj.bias", attn["out"]["bias"][i])
+        put(f"{pre}h.{i}.ln_2.weight", blocks["ln2"]["scale"][i])
+        put(f"{pre}h.{i}.ln_2.bias", blocks["ln2"]["bias"][i])
+        put(f"{pre}h.{i}.mlp.c_fc.weight", mlp["fc_in"]["kernel"][i])
+        put(f"{pre}h.{i}.mlp.c_fc.bias", mlp["fc_in"]["bias"][i])
+        put(f"{pre}h.{i}.mlp.c_proj.weight", mlp["fc_out"]["kernel"][i])
+        put(f"{pre}h.{i}.mlp.c_proj.bias", mlp["fc_out"]["bias"][i])
+    put(f"{pre}ln_f.weight", params["ln_f"]["scale"])
+    put(f"{pre}ln_f.bias", params["ln_f"]["bias"])
+    if f"{pre}wte.weight" in sd and "lm_head.weight" in sd:
+        sd["lm_head.weight"] = sd[f"{pre}wte.weight"]  # weight tying
+    hf_model.load_state_dict(sd)
+    return hf_model
 
 
 def gpt_config_from_hf(hf_config):
