@@ -133,6 +133,12 @@ class TrainerConfig:
     # the EMA weights (the reason to keep them) and they ride the same
     # sharding specs + checkpoint as the live params.
     ema_decay: float = 0.0
+    # Initialize model params from a flax-msgpack file (e.g. an imported
+    # HF checkpoint from tools/import_hf_gpt2.py) instead of random init.
+    # The tree structure/shapes must match the model exactly; params are
+    # cast to the precision policy's param dtype and placed into the
+    # run's shardings. Optimizer state still initializes fresh.
+    init_params_path: str = ""
     # Write metric scalars to TensorBoard (<workdir>/<name>/tb) next to
     # the profiler traces. JSONL remains the record of truth; the sink is
     # lazy-TF and degrades to a warning if TF is unusable.

@@ -212,6 +212,26 @@ class Trainer:
         state = self._mesh_scoped(
             jax.jit(self._init_state_fn, out_shardings=self.state_shardings)
         )(self._rng)
+        if self.cfg.trainer.init_params_path:
+            host = self._load_init_params(self.cfg.trainer.init_params_path)
+            # Free the random-init buffers BEFORE transferring the loaded
+            # ones: otherwise peak HBM transiently holds 2x params, which
+            # can OOM a model that otherwise fits. The EMA (when on) must
+            # start from the loaded weights too — seeding it with the
+            # discarded random init would make early evals score garbage.
+            stale = [state.params] + (
+                [state.ema_params] if state.ema_params is not None else []
+            )
+            for leaf in jax.tree.leaves(stale):
+                if hasattr(leaf, "delete"):
+                    leaf.delete()
+            new_params = jax.device_put(host, self.state_shardings.params)
+            replacements = {"params": new_params}
+            if state.ema_params is not None:
+                replacements["ema_params"] = jax.device_put(
+                    host, self.state_shardings.params
+                )
+            state = state.replace(**replacements)
         n_params = tree_param_count(state.params)
         self.logger.info(
             "initialized %s: %.2fM params over mesh %s",
@@ -229,6 +249,56 @@ class Trainer:
             # pipeline_microbatches (amortizes as M grows).
             self.logger.info("%s", summary)
         return state
+
+    def _load_init_params(self, path: str):
+        """Load + validate a flax-msgpack params pytree
+        (tools/import_hf_gpt2.py output); returns HOST numpy arrays in the
+        policy's param dtype (the caller places them into shardings).
+
+        Structure and shapes are validated against the model's own init
+        shapes BEFORE any device transfer — a mismatched checkpoint fails
+        with the offending paths, not an opaque XLA shape error.
+        """
+        from flax import serialization
+
+        with open(path, "rb") as fh:
+            loaded = serialization.msgpack_restore(fh.read())
+        got_paths = {
+            jax.tree_util.keystr(k): tuple(v.shape)
+            for k, v in jax.tree_util.tree_leaves_with_path(loaded)
+        }
+        want_paths = {
+            jax.tree_util.keystr(k): tuple(v.shape)
+            for k, v in jax.tree_util.tree_leaves_with_path(
+                self.state_shapes.params
+            )
+        }
+        if got_paths.keys() != want_paths.keys():
+            missing = sorted(want_paths.keys() - got_paths.keys())[:5]
+            extra = sorted(got_paths.keys() - want_paths.keys())[:5]
+            raise ValueError(
+                f"init_params_path {path!r} does not match the model tree "
+                f"(missing {missing}, unexpected {extra})"
+            )
+        bad = [
+            k for k in want_paths
+            if tuple(got_paths[k]) != tuple(want_paths[k])
+        ]
+        if bad:
+            raise ValueError(
+                f"init_params_path {path!r} shape mismatches at {bad[:5]}: "
+                + ", ".join(
+                    f"{k}: {got_paths[k]} != {want_paths[k]}" for k in bad[:5]
+                )
+            )
+        dtype = self.policy.param_dtype
+        loaded = jax.tree.map(lambda x: np.asarray(x, dtype), loaded)
+        self.logger.info(
+            "initialized params from %s (%.2fM params)",
+            path,
+            tree_param_count(loaded) / 1e6,
+        )
+        return loaded
 
     def _batch_shardings(self, batch: dict) -> dict:
         return self.pipeline.shardings_for(

@@ -100,3 +100,91 @@ def test_export_roundtrip_matches(hf_pair):
             fresh(tokens).logits.numpy(), hf(tokens).logits.numpy(),
             atol=1e-6, rtol=1e-6,
         )
+
+
+def test_trainer_init_from_imported_params(hf_pair, tmp_path):
+    """trainer.init_params_path: an imported HF checkpoint becomes the
+    training starting point — params in the state equal the file's, and a
+    wrong-shaped file is refused with the offending paths."""
+    from import_hf_gpt2 import save_params
+
+    from frl_distributed_ml_scaffold_tpu.config import (
+        apply_overrides,
+        get_config,
+    )
+    from frl_distributed_ml_scaffold_tpu.trainer.loop import Trainer
+
+    _, params, cfg = hf_pair
+    path = str(tmp_path / "hf.msgpack")
+    save_params(params, path)
+    overrides = [
+        f"model.{k}={getattr(cfg, k)}"
+        for k in ("vocab_size", "num_layers", "num_heads", "hidden_dim",
+                  "seq_len")
+    ] + [
+        f"data.vocab_size={cfg.vocab_size}", f"data.seq_len={cfg.seq_len}",
+        "data.global_batch_size=8", "precision.policy=fp32",
+        "checkpoint.enabled=false", f"workdir={tmp_path}",
+        f"trainer.init_params_path={path}",
+    ]
+    trainer = Trainer(apply_overrides(get_config("gpt2_medium_zero1"), overrides))
+    state = trainer.init_state()
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-7
+        ),
+        jax.device_get(state.params),
+        params,
+    )
+    # And one train step runs from the imported weights.
+    s2, metrics = trainer.train_step(state, trainer.pipeline.global_batch(0))
+    assert np.isfinite(float(jax.device_get(metrics["loss"])))
+
+    bad = apply_overrides(
+        get_config("gpt2_medium_zero1"),
+        overrides[:-1] + ["model.hidden_dim=48", f"trainer.init_params_path={path}"],
+    )
+    with pytest.raises(ValueError, match="shape mismatch"):
+        Trainer(bad).init_state()
+
+
+def test_init_params_path_seeds_ema_too(hf_pair, tmp_path):
+    """With EMA on, the imported weights must seed ema_params as well —
+    eval uses the EMA, so a random-init EMA would score garbage."""
+    from import_hf_gpt2 import save_params
+
+    from frl_distributed_ml_scaffold_tpu.config import (
+        apply_overrides,
+        get_config,
+    )
+    from frl_distributed_ml_scaffold_tpu.trainer.loop import Trainer
+
+    _, params, cfg = hf_pair
+    path = str(tmp_path / "hf.msgpack")
+    save_params(params, path)
+    trainer = Trainer(
+        apply_overrides(
+            get_config("gpt2_medium_zero1"),
+            [
+                f"model.vocab_size={cfg.vocab_size}",
+                f"model.num_layers={cfg.num_layers}",
+                f"model.num_heads={cfg.num_heads}",
+                f"model.hidden_dim={cfg.hidden_dim}",
+                f"model.seq_len={cfg.seq_len}",
+                f"data.vocab_size={cfg.vocab_size}",
+                f"data.seq_len={cfg.seq_len}",
+                "data.global_batch_size=8", "precision.policy=fp32",
+                "trainer.ema_decay=0.99", "checkpoint.enabled=false",
+                f"workdir={tmp_path}",
+                f"trainer.init_params_path={path}",
+            ],
+        )
+    )
+    state = trainer.init_state()
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)
+        ),
+        jax.device_get(state.ema_params),
+        jax.device_get(state.params),
+    )
