@@ -119,3 +119,122 @@ def generate(
     ) if max_new_tokens > 1 else ((cache, tok, done, rng), jnp.zeros((0, b), jnp.int32))
     new = jnp.concatenate([toks.T, last[:, None]], axis=1)  # [B, max_new]
     return jnp.concatenate([prompt, new], axis=1)
+
+
+def _gather_cache_rows(cache, rows, batch_rows: int):
+    """Reorder the per-beam KV rows of a decode cache.
+
+    Cache leaves are either per-layer K/V stacks ``[L, B*W, S, H, hd]``
+    (batch on axis 1 — gathered) or batchless bookkeeping (``cache_index``
+    ``[L]``, ``pos_index`` scalar — identical across beams, untouched).
+    """
+    return jax.tree.map(
+        lambda x: (
+            jnp.take(x, rows, axis=1)
+            if x.ndim >= 2 and x.shape[1] == batch_rows
+            else x
+        ),
+        cache,
+    )
+
+
+def beam_search(
+    model: Any,
+    params: Any,
+    prompt: jax.Array,
+    *,
+    max_new_tokens: int,
+    num_beams: int = 4,
+    eos_id: int | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Deterministic beam decode; returns ``([B, Tp+new] best tokens,
+    [B] sum-log-prob scores)``.
+
+    Same two-XLA-program shape as ``generate``: one prefill over the [B]
+    prompt (the cache is then row-repeated to [B*W] — cheaper than
+    prefilling W copies), one scanned decode step over all beams. Each
+    step extends every beam over the full vocab, keeps the top W of W*V
+    by accumulated log-prob, and reorders the KV cache rows by the
+    surviving beams' parents. Finished beams (``eos_id``) are frozen:
+    their only continuation is eos at zero additional log-prob. Scores
+    are raw sums (no length normalization), so with an eos the search
+    inherits model-length preferences — the standard simple variant.
+    """
+    cfg = model.config
+    b, tp = prompt.shape
+    w = num_beams
+    if max_new_tokens < 1:
+        raise ValueError(
+            f"max_new_tokens={max_new_tokens} < 1: the returned score is "
+            "the sum log-prob of the emitted tokens, so at least one must "
+            "be emitted"
+        )
+    if tp + max_new_tokens > cfg.seq_len:
+        raise ValueError(
+            f"prompt ({tp}) + max_new_tokens ({max_new_tokens}) exceeds the "
+            f"model context ({cfg.seq_len}) — the KV cache is sized to it"
+        )
+    if w < 1 or w > cfg.vocab_size:
+        raise ValueError(f"num_beams={w} not in [1, vocab={cfg.vocab_size}]")
+    prompt = prompt.astype(jnp.int32)
+
+    logits, vars_out = model.apply(
+        {"params": params}, prompt, decode=True, mutable=["cache"]
+    )
+    if isinstance(logits, tuple):
+        logits = logits[0]
+    lp0 = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32))  # [B, V]
+    scores, tok = jax.lax.top_k(lp0, w)  # [B, W] each
+    cache = jax.tree.map(
+        lambda x: (
+            jnp.repeat(x, w, axis=1) if x.ndim >= 2 and x.shape[1] == b else x
+        ),
+        vars_out["cache"],
+    )
+    finished = (
+        jnp.zeros((b, w), bool) if eos_id is None else tok == eos_id
+    )
+    buf = jnp.zeros((b, w, max_new_tokens), jnp.int32)
+    buf = buf.at[:, :, 0].set(tok)
+    batch_idx = jnp.arange(b)[:, None]
+
+    def step(carry, t):
+        cache, tok, scores, finished, buf = carry
+        logits, vars_out = model.apply(
+            {"params": params, "cache": cache},
+            tok.reshape(b * w)[:, None],
+            decode=True,
+            mutable=["cache"],
+        )
+        if isinstance(logits, tuple):
+            logits = logits[0]
+        lp = jax.nn.log_softmax(logits[:, 0].astype(jnp.float32))
+        lp = lp.reshape(b, w, -1)  # [B, W, V]
+        if eos_id is not None:
+            # Frozen beams may only repeat eos, for free — their score
+            # stays comparable while live beams keep extending.
+            eos_only = jnp.full_like(lp, jnp.finfo(jnp.float32).min)
+            eos_only = eos_only.at[..., eos_id].set(0.0)
+            lp = jnp.where(finished[..., None], eos_only, lp)
+        total = scores[..., None] + lp  # [B, W, V]
+        v = total.shape[-1]
+        new_scores, flat_idx = jax.lax.top_k(total.reshape(b, w * v), w)
+        src = flat_idx // v  # parent beam per survivor [B, W]
+        new_tok = (flat_idx % v).astype(jnp.int32)
+        rows = (batch_idx * w + src).reshape(-1)
+        cache = _gather_cache_rows(vars_out["cache"], rows, b * w)
+        buf = buf[batch_idx, src]  # reorder histories to surviving beams
+        buf = buf.at[:, :, t].set(new_tok)
+        finished = finished[batch_idx, src]
+        if eos_id is not None:
+            finished = finished | (new_tok == eos_id)
+        return (cache, new_tok, new_scores, finished, buf), None
+
+    if max_new_tokens > 1:
+        (cache, tok, scores, finished, buf), _ = jax.lax.scan(
+            step,
+            (cache, tok, scores, finished, buf),
+            jnp.arange(1, max_new_tokens),
+        )
+    # top_k keeps beams sorted by score: beam 0 is the argmax.
+    return jnp.concatenate([prompt, buf[:, 0]], axis=1), scores[:, 0]

@@ -145,3 +145,76 @@ def test_top_p_sampling_restricts_support(gpt):
         rng=jax.random.key(3),
     )
     np.testing.assert_array_equal(a, b)
+
+
+def test_beam_search_one_beam_equals_greedy(gpt):
+    from frl_distributed_ml_scaffold_tpu.models.generation import beam_search
+
+    model, params, tokens = gpt
+    greedy = generate(model, params, tokens, max_new_tokens=6, temperature=0.0)
+    beam, scores = beam_search(
+        model, params, tokens, max_new_tokens=6, num_beams=1
+    )
+    np.testing.assert_array_equal(np.asarray(beam), np.asarray(greedy))
+    assert scores.shape == (2,) and np.isfinite(np.asarray(scores)).all()
+
+
+def test_beam_search_beats_or_matches_greedy_logprob(gpt):
+    """The whole point of beams: the returned sequence's sum log-prob must
+    be >= greedy's (greedy is one path in the searched space)."""
+    from frl_distributed_ml_scaffold_tpu.models.generation import beam_search
+
+    model, params, tokens = gpt
+    n_new = 6
+
+    def seq_logprob(full):
+        logits = jit_apply(model, train=False)({"params": params}, full[:, :-1])
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        picked = jnp.take_along_axis(
+            lp[:, -n_new:], full[:, -n_new:, None].astype(jnp.int32), axis=-1
+        )[..., 0]
+        return picked.sum(-1)
+
+    greedy = generate(model, params, tokens, max_new_tokens=n_new, temperature=0.0)
+    beam, scores = beam_search(
+        model, params, tokens, max_new_tokens=n_new, num_beams=4
+    )
+    g_lp = np.asarray(seq_logprob(jnp.asarray(greedy)))
+    b_lp = np.asarray(seq_logprob(jnp.asarray(beam)))
+    assert (b_lp >= g_lp - 1e-4).all(), (b_lp, g_lp)
+    # And the search's own score agrees with the independent forward.
+    np.testing.assert_allclose(np.asarray(scores), b_lp, atol=2e-3, rtol=1e-4)
+
+
+def test_beam_search_eos_freezes_beams(gpt):
+    """A finished beam may only repeat eos at zero extra log-prob: its
+    score must freeze at the finishing step and the tail must be eos."""
+    from frl_distributed_ml_scaffold_tpu.models.generation import beam_search
+
+    model, params, tokens = gpt
+    # Use the greedy first token of row 0 as eos: beam 0 finishes at once.
+    eos = int(
+        generate(model, params, tokens, max_new_tokens=1, temperature=0.0)[0, -1]
+    )
+    out, scores = beam_search(
+        model, params, tokens, max_new_tokens=5, num_beams=3, eos_id=eos
+    )
+    out = np.asarray(out)
+    row0_new = out[0, 8:]
+    if row0_new[0] == eos:  # the eos beam won the search
+        assert (row0_new == eos).all()
+        # Frozen score == single-token log-prob of eos, independently
+        # computed from the full forward.
+        logits = jit_apply(model, train=False)({"params": params}, gpt[2])
+        lp = jax.nn.log_softmax(logits[0, -1].astype(jnp.float32))
+        np.testing.assert_allclose(
+            float(scores[0]), float(lp[eos]), atol=2e-3
+        )
+    else:  # a live beam out-scored the frozen one — also legal; check it
+        assert float(scores[0]) >= float(
+            jax.nn.log_softmax(
+                jit_apply(model, train=False)({"params": params}, gpt[2])[
+                    0, -1
+                ].astype(jnp.float32)
+            )[eos]
+        ) - 1e-4

@@ -32,6 +32,8 @@ def main() -> int:
     ap.add_argument("--top-k", type=int, default=40)
     ap.add_argument("--top-p", type=float, default=0.0,
                     help="nucleus sampling mass (0 = off)")
+    ap.add_argument("--beams", type=int, default=0,
+                    help="beam-search width (0 = sample instead)")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
 
@@ -72,16 +74,28 @@ def main() -> int:
         print("[generate_demo] random-init params (no --restore given)")
 
     t0 = time.perf_counter()
-    out = generate(
-        model,
-        params,
-        prompt,
-        max_new_tokens=args.max_new,
-        temperature=args.temperature,
-        top_k=args.top_k,
-        top_p=args.top_p,
-        rng=jax.random.key(args.seed + 1),
-    )
+    if args.beams > 0:
+        from frl_distributed_ml_scaffold_tpu.models.generation import (
+            beam_search,
+        )
+
+        out, scores = beam_search(
+            model, params, prompt,
+            max_new_tokens=args.max_new, num_beams=args.beams,
+        )
+        print(f"[generate_demo] beam scores: "
+              f"{[round(float(s), 2) for s in jax.device_get(scores)]}")
+    else:
+        out = generate(
+            model,
+            params,
+            prompt,
+            max_new_tokens=args.max_new,
+            temperature=args.temperature,
+            top_k=args.top_k,
+            top_p=args.top_p,
+            rng=jax.random.key(args.seed + 1),
+        )
     out = jax.device_get(out)
     dt = time.perf_counter() - t0
     print(f"[generate_demo] {args.max_new} tokens x {args.batch} seqs "
