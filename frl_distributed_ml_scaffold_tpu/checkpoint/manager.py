@@ -45,6 +45,56 @@ class Checkpointer:
     def latest_step(self) -> int | None:
         return self._mngr.latest_step()
 
+    def all_steps(self) -> list[int]:
+        return sorted(self._mngr.all_steps())
+
+    def restore_params_only(
+        self, state_shapes: Any, state_shardings: Any, step: int
+    ):
+        """Restore just the params subtree (``ocp.PLACEHOLDER`` skips the
+        optimizer moments/extras on disk — ~1/3 the I/O of a full-state
+        restore). Explicit per-leaf restore args carry the CALLER's
+        shardings, so this reshards across topologies like ``restore``
+        (PyTreeRestore would otherwise read the writer's sharding file,
+        which is invalid on a different device set). Returns params."""
+        abstract = jax.tree.map(
+            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+            state_shapes,
+            state_shardings,
+        )
+        target = abstract.replace(
+            opt_state=jax.tree.map(lambda _: ocp.PLACEHOLDER, abstract.opt_state),
+            extras=jax.tree.map(lambda _: ocp.PLACEHOLDER, abstract.extras),
+            ema_params=(
+                jax.tree.map(lambda _: ocp.PLACEHOLDER, abstract.ema_params)
+                if abstract.ema_params is not None
+                else None
+            ),
+        )
+
+        def _restore_arg(x):
+            if x is ocp.PLACEHOLDER:
+                return ocp.RestoreArgs()
+            return ocp.ArrayRestoreArgs(sharding=x.sharding, dtype=x.dtype)
+
+        restore_args = jax.tree.map(
+            _restore_arg, target, is_leaf=lambda x: x is ocp.PLACEHOLDER
+        )
+        # A dedicated read-only manager: orbax binds one handler type per
+        # item name per manager, and the main one serves StandardSave/
+        # StandardRestore for the training path.
+        reader = ocp.CheckpointManager(self.directory)
+        try:
+            restored = reader.restore(
+                step,
+                args=ocp.args.PyTreeRestore(
+                    item=target, restore_args=restore_args
+                ),
+            )
+        finally:
+            reader.close()
+        return restored.params
+
     def restore(self, state_shapes: Any, state_shardings: Any, step: int | None = None):
         """Restore into the given shardings (resharding as needed)."""
         step = self.latest_step() if step is None else step

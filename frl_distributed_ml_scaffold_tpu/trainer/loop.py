@@ -399,10 +399,26 @@ class Trainer:
         # step-indexed data stream aligned with what the model has seen.
         start_step = int(jax.device_get(state.step))
 
+        # The resolved config IS the experiment record: offline tools
+        # (tools/avg_checkpoints.py) and future resumes rebuild the exact
+        # model/optimizer from it without guessing CLI overrides.
+        from frl_distributed_ml_scaffold_tpu.config import config_to_dict
+        from frl_distributed_ml_scaffold_tpu.utils.logging import (
+            is_primary_process,
+        )
+
+        run_dir = os.path.join(cfg.workdir, cfg.name)
+        if is_primary_process():
+            os.makedirs(run_dir, exist_ok=True)
+            import json as _json
+
+            with open(os.path.join(run_dir, "config.json"), "w") as fh:
+                _json.dump(config_to_dict(cfg), fh, indent=1)
+
         metric_logger = MetricLogger(
-            os.path.join(cfg.workdir, cfg.name, "metrics.jsonl"),
+            os.path.join(run_dir, "metrics.jsonl"),
             tb_dir=(
-                os.path.join(cfg.workdir, cfg.name, "tb")
+                os.path.join(run_dir, "tb")
                 if cfg.trainer.tensorboard
                 else None
             ),
@@ -420,7 +436,7 @@ class Trainer:
         )
 
         profiler = WindowProfiler(
-            os.path.join(cfg.workdir, cfg.name, "trace"),
+            os.path.join(run_dir, "trace"),
             start_step=start_step + cfg.trainer.profile_start_step,
             num_steps=cfg.trainer.profile_steps,
         )

@@ -58,6 +58,38 @@ def test_save_restore_roundtrip(tmp_path):
     fresh.checkpointer.close()
 
 
+def test_restore_params_only_matches_full(tmp_path):
+    """Partial restore (params subtree via ocp.PLACEHOLDER) must equal the
+    params of a full-state restore — it is the avg_checkpoints/offline
+    path that skips reading the optimizer moments."""
+    cfg = ckpt_cfg(tmp_path)
+    trainer = Trainer(cfg)
+    final_state, _ = trainer.fit()
+    trainer.checkpointer.close()
+
+    fresh = Trainer(cfg)
+    full = fresh.checkpointer.restore_or_init(fresh)
+    params_only = fresh.checkpointer.restore_params_only(
+        fresh.state_shapes, fresh.state_shardings,
+        fresh.checkpointer.latest_step(),
+    )
+    assert_params_close(params_only, full.params)
+    fresh.checkpointer.close()
+
+    # Cross-topology: the same partial restore onto a 4-device mesh (the
+    # explicit ArrayRestoreArgs shardings are what makes PyTreeRestore
+    # safe off the writer's topology — the tool's any-host promise).
+    cfg4 = ckpt_cfg(tmp_path, ["mesh.data=4"])
+    t4 = Trainer(
+        cfg4, mesh_env=build_mesh(cfg4.mesh, devices=jax.devices()[:4])
+    )
+    p4 = t4.checkpointer.restore_params_only(
+        t4.state_shapes, t4.state_shardings, t4.checkpointer.latest_step()
+    )
+    assert_params_close(p4, full.params)
+    t4.checkpointer.close()
+
+
 def test_topology_change_restore(tmp_path):
     """C13 resharding restore: write on an 8-device mesh, read on 4 devices.
 

@@ -48,6 +48,14 @@ def test_mnist_fit_loop_and_eval(tmp_path):
     assert "loss" in last and last["loss"] < 2.0
     ev = trainer.evaluate(state, num_steps=3)
     assert ev["eval_accuracy"] > 0.5
+    # fit() records the resolved config — the experiment's reproducibility
+    # artifact (offline tools rebuild the exact model from it).
+    import json
+
+    with open(tmp_path / "mnist_mlp" / "config.json") as fh:
+        dumped = json.load(fh)
+    assert dumped["model"]["family"] == "mlp"
+    assert dumped["trainer"]["total_steps"] == 30
 
 
 def test_launcher_cli_runs(tmp_path, capsys):

@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Average the params of the last K checkpoints into one params file.
+
+Checkpoint averaging (Polyak-style, over saved snapshots rather than every
+step like ``trainer.ema_decay``) is the classic cheap eval boost for
+translation/LM recipes. Output is the same flax-msgpack format as
+``tools/import_hf_gpt2.py``, so the result plugs into
+``trainer.init_params_path`` or an eval-only run.
+
+    python tools/avg_checkpoints.py --workdir /runs/gpt2_medium_zero1 \
+        --last 3 --out avg.msgpack
+
+The averaging runs on CPU over host arrays — no TPU needed, safe on a
+machine without the training topology.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--workdir", required=True,
+                    help="the run's <workdir>/<config-name> directory "
+                         "(contains ckpt/)")
+    ap.add_argument("--last", type=int, default=3,
+                    help="how many most-recent checkpoints to average")
+    ap.add_argument("--out", required=True, help="output .msgpack path")
+    args = ap.parse_args()
+    if args.last <= 0:
+        ap.error(f"--last must be >= 1, got {args.last}")
+
+    import json
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from frl_distributed_ml_scaffold_tpu.config import (
+        ExperimentConfig,
+        apply_overrides,
+        config_from_dict,
+    )
+    from frl_distributed_ml_scaffold_tpu.trainer.loop import Trainer
+    from frl_distributed_ml_scaffold_tpu.utils.trees import tree_param_count
+    from import_hf_gpt2 import save_params  # same serialization surface
+
+    run_dir = os.path.abspath(args.workdir)
+    cfg_path = os.path.join(run_dir, "config.json")
+    if not os.path.isfile(cfg_path):
+        print(f"no config.json in {run_dir} (written by fit() since r2); "
+              "pass the run directory, not the workdir root", file=sys.stderr)
+        return 1
+    with open(cfg_path) as fh:
+        cfg = config_from_dict(ExperimentConfig, json.load(fh))
+    # Rebuild on THIS host's topology (1 CPU device): the checkpoint
+    # restore reshards from the writer's topology — the same mechanism
+    # the elastic path uses, so the tool works on any machine.
+    cfg = apply_overrides(cfg, [
+        "mesh.pipe=1", "mesh.data=-1", "mesh.fsdp=1", "mesh.seq=1",
+        "mesh.expert=1", "mesh.model=1", "mesh.dcn_data=1",
+        "checkpoint.enabled=true", "data.prefetch=0",
+        # Locate the ckpt/ by the DIRECTORY the user named, not the name
+        # recorded in config.json — archived/renamed runs must work.
+        f"name={os.path.basename(run_dir)}",
+        f"workdir={os.path.dirname(run_dir)}",
+    ])
+    trainer = Trainer(cfg)
+    ck = trainer.checkpointer
+    steps = ck.all_steps()[-args.last:]
+    if not steps:
+        print(f"no checkpoints under {run_dir}/ckpt", file=sys.stderr)
+        return 1
+
+    acc = None
+    for step in steps:
+        # Params-only partial restore (ocp.PLACEHOLDER skips the optimizer
+        # moments/extras): ~3x less I/O and host RAM than the full state.
+        state = ck.restore_params_only(
+            trainer.state_shapes, trainer.state_shardings, step
+        )
+        params = jax.tree.map(
+            lambda x: np.asarray(jax.device_get(x), np.float64), state
+        )
+        acc = params if acc is None else jax.tree.map(np.add, acc, params)
+        print(f"  + step {step}", file=sys.stderr)
+    avg = jax.tree.map(
+        lambda x: (x / len(steps)).astype(np.float32), acc
+    )
+    save_params(avg, args.out)
+    print(
+        f"wrote {args.out}: mean of steps {steps} "
+        f"({tree_param_count(avg)/1e6:.2f}M params)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -75,6 +75,8 @@ def main() -> int:
 
     t0 = time.perf_counter()
     if args.beams > 0:
+        print("[generate_demo] beam search is deterministic: "
+              "--temperature/--top-k/--top-p/--seed are ignored")
         from frl_distributed_ml_scaffold_tpu.models.generation import (
             beam_search,
         )
