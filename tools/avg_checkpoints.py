@@ -70,6 +70,9 @@ def main() -> int:
         "mesh.pipe=1", "mesh.data=-1", "mesh.fsdp=1", "mesh.seq=1",
         "mesh.expert=1", "mesh.model=1", "mesh.dcn_data=1",
         "checkpoint.enabled=true", "data.prefetch=0",
+        # This host need not satisfy TPU-only knobs or find aux files:
+        # the tool only rebuilds shapes/shardings and reads params.
+        "trainer.offload_opt_state=false", "trainer.init_params_path=",
         # Locate the ckpt/ by the DIRECTORY the user named, not the name
         # recorded in config.json — archived/renamed runs must work.
         f"name={os.path.basename(run_dir)}",

@@ -102,15 +102,25 @@ def main() -> int:
     dt = time.perf_counter() - t0
     print(f"[generate_demo] {args.max_new} tokens x {args.batch} seqs "
           f"in {dt:.2f}s ({args.batch * args.max_new / dt:.1f} tok/s incl. compile)")
-    # Second call hits the compile cache: steady-state decode throughput.
+    # Second call of the SAME decode path hits the compile cache:
+    # steady-state throughput (and for beam mode, the printed rows must
+    # remain the beam result — never overwrite with sampled output).
     t0 = time.perf_counter()
-    out = jax.device_get(
-        generate(
+    if args.beams > 0:
+        out, _ = beam_search(
             model, params, prompt,
-            max_new_tokens=args.max_new, temperature=args.temperature,
-            top_k=args.top_k, rng=jax.random.key(args.seed + 2),
+            max_new_tokens=args.max_new, num_beams=args.beams,
         )
-    )
+        out = jax.device_get(out)
+    else:
+        out = jax.device_get(
+            generate(
+                model, params, prompt,
+                max_new_tokens=args.max_new, temperature=args.temperature,
+                top_k=args.top_k, top_p=args.top_p,
+                rng=jax.random.key(args.seed + 2),
+            )
+        )
     dt = time.perf_counter() - t0
     print(f"[generate_demo] warm: {args.batch * args.max_new / dt:.1f} tok/s "
           f"({dt / args.max_new * 1e3:.1f} ms/token step)")
