@@ -146,9 +146,10 @@ def beam_search(
     max_new_tokens: int,
     num_beams: int = 4,
     eos_id: int | None = None,
+    length_penalty: float = 0.0,
 ) -> tuple[jax.Array, jax.Array]:
     """Deterministic beam decode; returns ``([B, Tp+new] best tokens,
-    [B] sum-log-prob scores)``.
+    [B] scores)``.
 
     Same two-XLA-program shape as ``generate``: one prefill over the [B]
     prompt (the cache is then row-repeated to [B*W] — cheaper than
@@ -156,9 +157,14 @@ def beam_search(
     step extends every beam over the full vocab, keeps the top W of W*V
     by accumulated log-prob, and reorders the KV cache rows by the
     surviving beams' parents. Finished beams (``eos_id``) are frozen:
-    their only continuation is eos at zero additional log-prob. Scores
-    are raw sums (no length normalization), so with an eos the search
-    inherits model-length preferences — the standard simple variant.
+    their only continuation is eos at zero additional log-prob.
+
+    Scoring: beams are SEARCHED by raw summed log-prob; with
+    ``length_penalty`` alpha > 0, the FINAL ranking divides each beam's
+    sum by ``len_emitted**alpha`` (GNMT-style, where len counts tokens up
+    to and including the first eos) — countering raw-sum's short-sequence
+    bias. The returned score is the ranked quantity (raw sum when
+    alpha=0).
     """
     cfg = model.config
     b, tp = prompt.shape
@@ -236,5 +242,26 @@ def beam_search(
             (cache, tok, scores, finished, buf),
             jnp.arange(1, max_new_tokens),
         )
-    # top_k keeps beams sorted by score: beam 0 is the argmax.
+    if length_penalty > 0.0:
+        # Re-rank by length-normalized score (search stays raw-sum: the
+        # normalization is not monotone across different-length prefixes,
+        # so applying it per-step would break the beam invariant).
+        if eos_id is None:
+            # Every beam has the same length: a constant division — no
+            # reordering can occur, so don't sort (an unstable reorder on
+            # f32 ties would needlessly swap equal-scored beams).
+            scores = scores / float(max_new_tokens) ** length_penalty
+        else:
+            is_eos = buf == eos_id
+            first = jnp.argmax(is_eos, axis=-1)
+            lens = jnp.where(
+                is_eos.any(-1), first + 1, max_new_tokens
+            ).astype(jnp.float32)
+            ranked = scores / lens**length_penalty
+            # argsort(-x) is stable-descending: ties keep the raw-score
+            # beam order instead of flipping to the worst tied beam.
+            order = jnp.argsort(-ranked, axis=1)
+            buf = jnp.take_along_axis(buf, order[..., None], axis=1)
+            scores = jnp.take_along_axis(ranked, order, axis=1)
+    # Beams are sorted by (possibly re-ranked) score: beam 0 is the argmax.
     return jnp.concatenate([prompt, buf[:, 0]], axis=1), scores[:, 0]

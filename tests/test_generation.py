@@ -218,3 +218,24 @@ def test_beam_search_eos_freezes_beams(gpt):
                 ].astype(jnp.float32)
             )[eos]
         ) - 1e-4
+
+
+def test_beam_search_length_penalty_reranks(gpt):
+    """alpha=0 returns raw sums; alpha>0 returns sum/len**alpha. With no
+    eos every beam has the same length, so the winning SEQUENCE must be
+    identical and the score exactly the normalized raw score."""
+    from frl_distributed_ml_scaffold_tpu.models.generation import beam_search
+
+    model, params, tokens = gpt
+    n_new = 5
+    raw_toks, raw_scores = beam_search(
+        model, params, tokens, max_new_tokens=n_new, num_beams=3
+    )
+    lp_toks, lp_scores = beam_search(
+        model, params, tokens, max_new_tokens=n_new, num_beams=3,
+        length_penalty=1.0,
+    )
+    np.testing.assert_array_equal(np.asarray(lp_toks), np.asarray(raw_toks))
+    np.testing.assert_allclose(
+        np.asarray(lp_scores), np.asarray(raw_scores) / n_new, rtol=1e-6
+    )

@@ -34,6 +34,8 @@ def main() -> int:
                     help="nucleus sampling mass (0 = off)")
     ap.add_argument("--beams", type=int, default=0,
                     help="beam-search width (0 = sample instead)")
+    ap.add_argument("--length-penalty", type=float, default=0.0,
+                    help="beam re-rank: score / len**alpha (0 = raw sum)")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
 
@@ -84,6 +86,7 @@ def main() -> int:
         out, scores = beam_search(
             model, params, prompt,
             max_new_tokens=args.max_new, num_beams=args.beams,
+            length_penalty=args.length_penalty,
         )
         print(f"[generate_demo] beam scores: "
               f"{[round(float(s), 2) for s in jax.device_get(scores)]}")
@@ -110,6 +113,7 @@ def main() -> int:
         out, _ = beam_search(
             model, params, prompt,
             max_new_tokens=args.max_new, num_beams=args.beams,
+            length_penalty=args.length_penalty,
         )
         out = jax.device_get(out)
     else:
