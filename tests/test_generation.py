@@ -239,3 +239,24 @@ def test_beam_search_length_penalty_reranks(gpt):
     np.testing.assert_allclose(
         np.asarray(lp_scores), np.asarray(raw_scores) / n_new, rtol=1e-6
     )
+
+
+def test_generation_works_with_moe_model():
+    """The MoE GPT returns (logits, aux) tuples — prefill, cached decode,
+    and beam search must all handle that shape (and the expert routing
+    must run in decode mode)."""
+    from frl_distributed_ml_scaffold_tpu.config.schema import MoEConfig
+    from frl_distributed_ml_scaffold_tpu.models.generation import beam_search
+
+    model = GPT(
+        GPTConfig(**TINY, moe=MoEConfig(num_experts=4, top_k=2)), FP32
+    )
+    tokens = jax.random.randint(jax.random.key(4), (2, 6), 0, 64)
+    params = jit_init(model, tokens, train=False)["params"]
+    out = generate(model, params, tokens, max_new_tokens=4, temperature=0.0)
+    assert out.shape == (2, 10) and int(np.asarray(out).max()) < 64
+    beam, scores = beam_search(
+        model, params, tokens, max_new_tokens=4, num_beams=2
+    )
+    assert beam.shape == (2, 10)
+    assert np.isfinite(np.asarray(scores)).all()

@@ -13,8 +13,13 @@ from frl_distributed_ml_scaffold_tpu.utils.profiling import (
     annotate,
 )
 
+import pytest
 
-def test_trainer_profile_window_writes_trace(tmp_path):
+
+@pytest.fixture(scope="module")
+def profiled_run(tmp_path_factory):
+    """One profiling-enabled trainer run shared by the trace tests."""
+    workdir = tmp_path_factory.mktemp("profiled")
     cfg = apply_overrides(
         get_config("mnist_mlp"),
         [
@@ -24,15 +29,17 @@ def test_trainer_profile_window_writes_trace(tmp_path):
             "trainer.profile_start_step=2",
             "data.global_batch_size=32",
             "checkpoint.enabled=false",
-            f"workdir={tmp_path}",
+            f"workdir={workdir}",
         ],
     )
-    trainer = Trainer(cfg)
-    trainer.fit()
-    trace_root = os.path.join(tmp_path, cfg.name, "trace")
+    Trainer(cfg).fit()
+    return os.path.join(workdir, cfg.name, "trace")
+
+
+def test_trainer_profile_window_writes_trace(profiled_run):
     # jax.profiler writes plugins/profile/<ts>/*.xplane.pb under the dir.
-    assert glob.glob(os.path.join(trace_root, "**", "*.xplane.pb"),
-                     recursive=True), f"no trace written under {trace_root}"
+    assert glob.glob(os.path.join(profiled_run, "**", "*.xplane.pb"),
+                     recursive=True), f"no trace written under {profiled_run}"
 
 
 def test_window_profiler_short_run_stops_cleanly(tmp_path):
@@ -56,3 +63,22 @@ def test_annotate_and_flags():
         pass
     flags = hlo_dump_flags("/tmp/dump")
     assert "--xla_dump_to=/tmp/dump" in flags
+
+
+def test_trace_analyze_reports_cleanly_on_sim_trace(profiled_run):
+    """tools/trace_analyze.py on a CPU-sim capture must say there is no
+    TPU plane (instead of silent empty output) and exit 0; on-chip traces
+    get the per-op table."""
+    import subprocess
+    import sys
+
+    tool = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "..", "tools",
+        "trace_analyze.py",
+    )
+    r = subprocess.run(
+        [sys.executable, tool, profiled_run],
+        capture_output=True, text=True, timeout=180,
+    )
+    assert r.returncode == 0, r.stderr[-400:]
+    assert "no /device:TPU plane" in r.stdout

@@ -41,9 +41,19 @@ def main() -> int:
     with open(path, "rb") as fh:
         xs.ParseFromString(fh.read())
 
-    for plane in xs.planes:
-        if not plane.name.startswith("/device:TPU"):
-            continue
+    tpu_planes = [
+        p for p in xs.planes if p.name.startswith("/device:TPU")
+    ]
+    if not tpu_planes:
+        # CPU-sim traces carry host thread lines, not per-op device
+        # lanes — say so instead of printing nothing.
+        print(
+            f"no /device:TPU plane in {path} (planes: "
+            f"{[p.name for p in xs.planes]}); capture on real TPU for "
+            "the per-op table"
+        )
+        return 0
+    for plane in tpu_planes:
         emeta = {m.id: m.name for m in plane.event_metadata.values()}
         for line in plane.lines:
             if line.name not in ("XLA Ops", "Steps"):
