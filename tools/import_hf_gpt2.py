@@ -220,11 +220,31 @@ def load_params(path: str) -> dict:
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--hf-dir", required=True,
-                    help="local HF checkpoint directory (no network fetch)")
-    ap.add_argument("--out", required=True, help="output .msgpack path")
+                    help="local HF checkpoint directory (no network fetch); "
+                         "in --export mode it supplies the target config")
+    ap.add_argument("--out", required=True,
+                    help="output path: .msgpack (import) or an HF "
+                         "save_pretrained directory (--export)")
+    ap.add_argument("--export", default=None, metavar="PARAMS_MSGPACK",
+                    help="reverse direction: load this framework's params "
+                         "file and write an HF checkpoint to --out")
     args = ap.parse_args()
 
-    from transformers import GPT2LMHeadModel
+    from transformers import GPT2Config, GPT2LMHeadModel
+
+    if args.export:
+        # Config-only target: every tensor gets overwritten, so don't
+        # deserialize the (possibly multi-GB) source weights; a bare
+        # config directory works too.
+        hf_cfg = GPT2Config.from_pretrained(args.hf_dir)
+        gpt_config_from_hf(hf_cfg)  # refuses unsupported variants loudly
+        hf = GPT2LMHeadModel(hf_cfg)
+        params = load_params(args.export)
+        params_to_hf_gpt2(params, hf)
+        hf.save_pretrained(args.out)
+        print(f"wrote HF checkpoint to {args.out} "
+              f"(config from {args.hf_dir})")
+        return 0
 
     hf = GPT2LMHeadModel.from_pretrained(args.hf_dir)
     params = hf_gpt2_to_params(hf)
