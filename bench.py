@@ -188,13 +188,18 @@ def run_all(out_path: str = "BENCH_TABLE.jsonl") -> int:
     _respect_platform_env()
     kind, probe_err = probe_backend()
     if probe_err is not None:
-        rec = {"config": "_probe", "error": probe_err}
-        with open(out_path, "w") as fh:
-            fh.write(json.dumps(rec) + "\n")
+        # Do NOT touch out_path: a dead relay must never clobber the
+        # last good capture with a one-line error record.
+        rec = {"config": "_probe", "error": probe_err,
+               "note": f"existing {out_path} left untouched"}
         print(json.dumps(rec), flush=True)
         return 1
     rows = []
-    with open(out_path, "w") as fh:
+    # Stage into a temp file: the live table is replaced only when at
+    # least one real record succeeded, so a backend that dies mid-run
+    # cannot destroy the last good capture either.
+    tmp_path = out_path + ".tmp"
+    with open(tmp_path, "w") as fh:
         for name, overrides, steps in ALL_CONFIGS:
             _progress(f"benchmarking {name} ...")
             try:
@@ -210,6 +215,11 @@ def run_all(out_path: str = "BENCH_TABLE.jsonl") -> int:
             fh.flush()
             print(json.dumps(rec))
     ok = [r for r in rows if "error" not in r]
+    if ok:
+        os.replace(tmp_path, out_path)
+    else:
+        os.remove(tmp_path)
+        _progress(f"every config failed; existing {out_path} left untouched")
     print(f"\n{'config':28s} {'samples/s/chip':>14s} {'step_ms':>9s} {'mfu':>6s}  mesh")
     for r in ok:
         mfu = f"{r['mfu']:.3f}" if "mfu" in r else "-"

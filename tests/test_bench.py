@@ -62,3 +62,35 @@ def test_run_all_writes_jsonl(tmp_path, monkeypatch):
     assert bench.run_all(str(out)) == 0
     lines = [json.loads(l) for l in out.read_text().splitlines()]
     assert len(lines) == 1 and lines[0]["config"] == "mnist_mlp"
+
+
+def test_run_all_preserves_table_when_backend_down(tmp_path, monkeypatch):
+    """A dead relay must never clobber the last good BENCH_TABLE capture
+    with a one-line probe-error record."""
+    import bench
+
+    table = tmp_path / "BENCH_TABLE.jsonl"
+    table.write_text('{"config": "imagenet_rn50_ddp", "good": true}\n')
+    monkeypatch.setattr(
+        bench, "probe_backend", lambda: (None, "backend init timeout")
+    )
+    rc = bench.run_all(str(table))
+    assert rc == 1
+    assert table.read_text() == '{"config": "imagenet_rn50_ddp", "good": true}\n'
+
+
+def test_run_all_preserves_table_when_all_configs_fail(tmp_path, monkeypatch):
+    """Backend dies AFTER a successful probe: all rows error out — the
+    previous capture must still survive (staged-tmp-file invariant)."""
+    import bench
+
+    table = tmp_path / "BENCH_TABLE.jsonl"
+    table.write_text('{"config": "imagenet_rn50_ddp", "good": true}\n')
+    monkeypatch.setattr(bench, "probe_backend", lambda: ("fake-chip", None))
+    def boom(*a, **k):
+        raise RuntimeError("backend died mid-run")
+    monkeypatch.setattr(bench, "bench_config", boom)
+    rc = bench.run_all(str(table))
+    assert rc == 1
+    assert table.read_text() == '{"config": "imagenet_rn50_ddp", "good": true}\n'
+    assert not (tmp_path / "BENCH_TABLE.jsonl.tmp").exists()
