@@ -195,31 +195,38 @@ def run_all(out_path: str = "BENCH_TABLE.jsonl") -> int:
         print(json.dumps(rec), flush=True)
         return 1
     rows = []
-    # Stage into a temp file: the live table is replaced only when at
-    # least one real record succeeded, so a backend that dies mid-run
-    # cannot destroy the last good capture either.
+    # Stage into a temp file; the live table is replaced ALL-OR-NOTHING:
+    # it is the evidence artifact, and a partial table would silently
+    # drop the last good rows of whichever configs failed this run.
+    # Every row (success or error) still streams to stdout regardless.
     tmp_path = out_path + ".tmp"
-    with open(tmp_path, "w") as fh:
-        for name, overrides, steps in ALL_CONFIGS:
-            _progress(f"benchmarking {name} ...")
-            try:
-                perf = bench_config(
-                    name, overrides + ["trainer.log_every=1000000"],
-                    steps=steps, warmup=2,
-                )
-                rec = perf["_record"]
-            except Exception as e:  # record the failure, keep benching
-                rec = {"config": name, "error": str(e)[:300]}
-            rows.append(rec)
-            fh.write(json.dumps(rec) + "\n")
-            fh.flush()
-            print(json.dumps(rec))
-    ok = [r for r in rows if "error" not in r]
-    if ok:
-        os.replace(tmp_path, out_path)
-    else:
-        os.remove(tmp_path)
-        _progress(f"every config failed; existing {out_path} left untouched")
+    try:
+        with open(tmp_path, "w") as fh:
+            for name, overrides, steps in ALL_CONFIGS:
+                _progress(f"benchmarking {name} ...")
+                try:
+                    perf = bench_config(
+                        name, overrides + ["trainer.log_every=1000000"],
+                        steps=steps, warmup=2,
+                    )
+                    rec = perf["_record"]
+                except Exception as e:  # record the failure, keep benching
+                    rec = {"config": name, "error": str(e)[:300]}
+                rows.append(rec)
+                fh.write(json.dumps(rec) + "\n")
+                fh.flush()
+                print(json.dumps(rec))
+        ok = [r for r in rows if "error" not in r]
+        if len(ok) == len(rows):
+            os.replace(tmp_path, out_path)
+        else:
+            _progress(
+                f"{len(rows) - len(ok)} config(s) failed; existing "
+                f"{out_path} left untouched"
+            )
+    finally:
+        if os.path.exists(tmp_path):  # error/partial run or interrupt
+            os.remove(tmp_path)
     print(f"\n{'config':28s} {'samples/s/chip':>14s} {'step_ms':>9s} {'mfu':>6s}  mesh")
     for r in ok:
         mfu = f"{r['mfu']:.3f}" if "mfu" in r else "-"

@@ -94,3 +94,60 @@ def test_run_all_preserves_table_when_all_configs_fail(tmp_path, monkeypatch):
     assert rc == 1
     assert table.read_text() == '{"config": "imagenet_rn50_ddp", "good": true}\n'
     assert not (tmp_path / "BENCH_TABLE.jsonl.tmp").exists()
+
+
+def test_run_all_preserves_table_on_partial_failure(tmp_path, monkeypatch):
+    """Replacement is all-or-nothing: one config succeeding while others
+    fail must not drop the failed configs' previous good rows."""
+    import bench
+
+    table = tmp_path / "BENCH_TABLE.jsonl"
+    table.write_text('{"config": "old", "good": true}\n')
+    monkeypatch.setattr(bench, "probe_backend", lambda: ("fake-chip", None))
+    calls = []
+
+    def flaky(name, overrides, *, steps, warmup):
+        calls.append(name)
+        if len(calls) > 1:
+            raise RuntimeError("backend died mid-run")
+        return {"_record": {"config": name, "samples_per_sec_per_chip": 1.0,
+                            "step_time_median_s": 0.001, "mesh": {}}}
+
+    monkeypatch.setattr(bench, "bench_config", flaky)
+    rc = bench.run_all(str(table))
+    assert rc == 1
+    assert table.read_text() == '{"config": "old", "good": true}\n'
+    assert not (tmp_path / "BENCH_TABLE.jsonl.tmp").exists()
+
+
+def test_main_falls_through_candidate_ladder(monkeypatch, capsys):
+    """If the headline candidate's child fails, main() must fall through
+    to the next candidate and still print exactly one final JSON line."""
+    import json as _json
+
+    import bench
+
+    monkeypatch.setattr(bench, "probe_backend", lambda: ("fake-chip", None))
+
+    calls = []
+
+    def fake_run_bounded(argv, timeout_s):
+        spec = _json.loads(argv[argv.index("--child") + 1])
+        calls.append(spec["config"])
+        if spec["config"] == "imagenet_rn50_ddp":
+            return 1, "", "simulated OOM"  # child failed
+        result = {"metric": spec["metric"], "value": 123.0,
+                  "unit": "samples/sec/chip", "vs_baseline": 0.5}
+        return 0, "RESULT " + _json.dumps(result) + "\n", ""
+
+    monkeypatch.setattr(bench, "_run_bounded", fake_run_bounded)
+    monkeypatch.setattr("sys.argv", ["bench.py"])
+    rc = bench.main()
+    assert rc == 0
+    assert calls == ["imagenet_rn50_ddp", "mnist_mlp"]
+    final = [l for l in capsys.readouterr().out.splitlines()
+             if l.startswith("{")]
+    assert len(final) == 1
+    rec = _json.loads(final[0])
+    assert rec["metric"] == "mnist_mlp_samples_per_sec_per_chip"
+    assert rec["value"] == 123.0
