@@ -160,13 +160,35 @@ def _imported_names(tree) -> "list[str]":
 def _assert_no_cuda_imports() -> None:
     """The north-star constraint: zero CUDA/NCCL imports in the TPU path.
 
-    Checked statically over the framework's own sources: an embedding
-    process may legitimately hold torch (e.g. tools/import_hf_gpt2.py
-    converts HF checkpoints on the host), so ``sys.modules`` says nothing
-    about whether *this framework* depends on the CUDA stack — its code
-    does not, and this scan proves it on every launch.
+    Two complementary tiers (neither alone is sufficient):
+
+    - **Static** AST scan over the framework's own sources — proves *this
+      framework's code* declares no CUDA-stack dependency, including
+      dynamic ``importlib.import_module("...")`` forms with literal
+      arguments. Blind to what third parties import at runtime.
+    - **Runtime** ``sys.modules`` check — catches a banned module pulled
+      in transitively (a dependency importing torch behind our back) or
+      via a non-literal dynamic import the AST scan cannot see. An
+      embedding process that legitimately holds host torch (e.g.
+      tools/import_hf_gpt2.py converts HF checkpoints on the host) opts
+      out explicitly with ``FRL_ALLOW_HOST_TORCH=1`` — the escape hatch
+      is deliberate and narrow: it waives only the runtime tier, never
+      the source scan.
     """
     import ast
+
+    if os.environ.get("FRL_ALLOW_HOST_TORCH", "") in ("", "0"):
+        loaded = [
+            m for m in _BANNED_IMPORT_PREFIXES
+            if m in sys.modules
+            or any(n.startswith(m + ".") for n in sys.modules)
+        ]
+        if loaded:
+            raise RuntimeError(
+                f"CUDA-path modules loaded in the launch process: {loaded} "
+                "(set FRL_ALLOW_HOST_TORCH=1 if this embedding process "
+                "holds host torch deliberately)"
+            )
 
     pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     offenders = []

@@ -41,7 +41,9 @@ def _sample(
         # would keep every token tied with the boundary logit, silently
         # disabling the filter on uniform/tied distributions.
         b = logits.shape[0]
-        order = jnp.argsort(logits, axis=-1)[:, ::-1]  # descending, stable
+        # Negate for a genuinely stable descending order (reversing an
+        # ascending stable sort would invert tie order at the boundary).
+        order = jnp.argsort(-logits, axis=-1)
         sorted_logits = jnp.take_along_axis(logits, order, axis=-1)
         probs = jax.nn.softmax(sorted_logits, axis=-1)
         mass_before = jnp.cumsum(probs, axis=-1) - probs

@@ -28,6 +28,12 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# The HF-interop tests load host torch into this (shared) pytest process;
+# the launcher's *runtime* no-CUDA tier would then trip on every later
+# launch-path test. That tier is for real launch processes — waive it
+# suite-wide and exercise its semantics explicitly in test_train_mnist.
+os.environ.setdefault("FRL_ALLOW_HOST_TORCH", "1")
+
 # Persistent compilation cache (repo-local, gitignored): the suite's wall
 # time is dominated by XLA compiles of the same tiny models on the same
 # 8-device mesh; caching them across runs cuts repeat `pytest` runs by

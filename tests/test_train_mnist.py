@@ -1,7 +1,10 @@
 """End-to-end slice (SURVEY §7 stage 2 / §4 integration): MLP on (synthetic)
 MNIST through the full launcher→config→data→step→metrics path."""
 
+import sys
+
 import numpy as np
+import pytest
 
 from frl_distributed_ml_scaffold_tpu.config import apply_overrides, get_config
 from frl_distributed_ml_scaffold_tpu.trainer.loop import Trainer
@@ -124,3 +127,23 @@ def test_cuda_import_scan_semantics():
 
     ok = ast.parse('"""example:\n    import torch\n"""\nimport numpy\n')
     assert "torch" not in set(_imported_names(ok))
+
+
+def test_cuda_runtime_check_semantics(monkeypatch):
+    """The runtime tier catches banned modules loaded in the launch process
+    (e.g. pulled transitively, invisible to the static scan) and is waived
+    only by the explicit FRL_ALLOW_HOST_TORCH escape hatch."""
+    import types
+
+    from frl_distributed_ml_scaffold_tpu.launcher.launch import (
+        _assert_no_cuda_imports,
+    )
+
+    monkeypatch.delenv("FRL_ALLOW_HOST_TORCH", raising=False)
+    monkeypatch.delitem(sys.modules, "torch", raising=False)
+    monkeypatch.setitem(sys.modules, "cupy", types.ModuleType("cupy"))
+    with pytest.raises(RuntimeError, match="cupy"):
+        _assert_no_cuda_imports()
+
+    monkeypatch.setenv("FRL_ALLOW_HOST_TORCH", "1")
+    _assert_no_cuda_imports()  # waived: only the static scan runs

@@ -68,13 +68,21 @@ def main() -> int:
             print(json.dumps(rec), flush=True)
 
     def timed(fn, x):
-        fn(x)  # compile
-        jax.device_get(jnp.zeros(()))  # settle
+        out0 = fn(x)  # compile
+        # Settle on the compile call's OWN output — syncing on an unrelated
+        # array would not order after fn(x)'s execution, letting leftover
+        # compile-call work bleed into the first timed iteration. Must be
+        # block_until_ready, not device_get: collective outputs sharded
+        # P(axis) across a multi-host pod are not fully addressable, so
+        # any host fetch raises; blocking needs no transfer. (The relay's
+        # slow block_until_ready RPC is a single-chip quirk; this tool
+        # only ever times multi-device meshes.)
+        jax.block_until_ready(out0)
         t0 = time.perf_counter()
         out = None
         for _ in range(args.iters):
             out = fn(x)
-        jax.device_get(jax.tree.leaves(out)[0].ravel()[:1])
+        jax.block_until_ready(out)
         return (time.perf_counter() - t0) / args.iters
 
     OPS = {
