@@ -1,0 +1,55 @@
+"""Multi-process elastic kill-and-resume (SURVEY C14, call stack (d)).
+
+Composes the two tiers that were previously only proven separately
+(test_multiprocess.py: 2-process rendezvous/training; test_elastic.py:
+single-host crash→restart→resume): TWO supervised processes rendezvous over
+``jax.distributed``; the coordinator's child hard-dies mid-run (fault
+injection — the moral equivalent of SIGKILL); the surviving process's child
+detects the peer loss through the coordination service and exits; each
+host's supervisor restarts its child; the 2-process group re-forms and
+training resumes from the last sharded checkpoint with no step duplicated
+or lost. This is BASELINE config 5's "multi-node elastic" capability on
+real process boundaries.
+"""
+
+import json
+import os
+
+from _mp_harness import free_port, rendezvous_env, run_workers
+
+
+def test_multiprocess_kill_and_resume(tmp_path):
+    env_base = rendezvous_env(tmp_path, free_port(), device_count=2)
+    envs = []
+    for pid in range(2):
+        env = {**env_base, "FRL_TPU_PROCESS_ID": str(pid)}
+        if pid == 0:
+            # Kill the COORDINATOR's child: the harder failure mode — the
+            # peer loses the coordination service itself, not just a member.
+            env["FRL_FAULT_AT_STEP"] = "9"
+        envs.append(env)
+    rcs, outputs = run_workers("_elastic_worker.py", envs, timeout=280)
+    for rc, out in zip(rcs, outputs):
+        assert rc == 0, f"supervisor failed:\n{out[-3000:]}"
+
+    # Each host's supervisor went through exactly one restart cycle: the
+    # faulted child on host 0, the peer-loss exit on host 1.
+    for out in outputs:
+        assert "elastic: run completed after 1 restart(s)" in out, out[-3000:]
+    assert "fault injection: hard-exit" in outputs[0]
+    # The survivor died to the coordination service noticing the dead peer,
+    # not to the fault hook (it was never armed there).
+    assert "fault injection" not in outputs[1]
+
+    run_dir = os.path.join(str(tmp_path), "mnist_mlp")
+    assert os.path.exists(os.path.join(run_dir, "fault_injected"))
+    # Proof of resume-not-restart: metrics.jsonl (process-0-gated, append-
+    # only across child generations) — run 1 logs steps 4 and 8, dies after
+    # 9; run 2 restores the step-8 checkpoint and logs only 12.
+    with open(os.path.join(run_dir, "metrics.jsonl")) as fh:
+        steps = [json.loads(line)["step"] for line in fh]
+    assert steps == [4, 8, 12], steps
+    ckpt_steps = sorted(
+        int(d) for d in os.listdir(os.path.join(run_dir, "ckpt")) if d.isdigit()
+    )
+    assert 12 in ckpt_steps

@@ -4,55 +4,21 @@ collectives/data-sharding actually execute — on CPU, via a real TCP
 rendezvous between two subprocesses (VERDICT r1 #5)."""
 
 import json
-import os
-import socket
-import subprocess
-import sys
 
 import numpy as np
 import pytest
 
-
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+from _mp_harness import free_port, rendezvous_env, run_workers
 
 
 def test_two_process_init_collectives_and_train(tmp_path):
-    port = _free_port()
-    workers = []
-    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env_base = {
-        **os.environ,
-        "FRL_TPU_COORDINATOR": f"127.0.0.1:{port}",
-        "FRL_TPU_NUM_PROCESSES": "2",
-        "FRL_TEST_WORKDIR": str(tmp_path),
-        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
-        # Script-by-path puts tests/ on sys.path, not the repo root; keep any
-        # existing entries (the axon sitecustomize lives on PYTHONPATH).
-        "PYTHONPATH": repo_root
-        + (os.pathsep + os.environ["PYTHONPATH"] if os.environ.get("PYTHONPATH") else ""),
-    }
-    script = os.path.join(os.path.dirname(__file__), "_twoproc_worker.py")
-    for pid in range(2):
-        env = {**env_base, "FRL_TPU_PROCESS_ID": str(pid)}
-        workers.append(
-            subprocess.Popen(
-                [sys.executable, script],
-                env=env,
-                stdout=subprocess.PIPE,
-                stderr=subprocess.STDOUT,
-                text=True,
-                cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-            )
-        )
-    outputs = []
-    for w in workers:
-        out, _ = w.communicate(timeout=280)
-        outputs.append(out)
-    for w, out in zip(workers, outputs):
-        assert w.returncode == 0, f"worker failed:\n{out[-3000:]}"
+    env_base = rendezvous_env(tmp_path, free_port(), device_count=4)
+    envs = [
+        {**env_base, "FRL_TPU_PROCESS_ID": str(pid)} for pid in range(2)
+    ]
+    rcs, outputs = run_workers("_twoproc_worker.py", envs, timeout=280)
+    for rc, out in zip(rcs, outputs):
+        assert rc == 0, f"worker failed:\n{out[-3000:]}"
 
     checks = []
     for out in outputs:
