@@ -55,6 +55,31 @@ def _sample(
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
+def _plain_stack(model: Any, params: Any) -> tuple[Any, Any]:
+    """Decode always runs on the plain layer stack: a pipeline-trained
+    model (``pipeline_stages > 1``) is swapped for its ``stages=1`` twin
+    and the stage-stacked weights are restacked to ``[L, ...]`` (a pure
+    reshape — models/gpt.py ``unstack_pipeline_params``). Weights are
+    layout-compatible by construction, so PP checkpoints generate without
+    any config surgery. The restack runs per call (free under jit after
+    trace); an eager sampling loop over a large PP checkpoint should call
+    ``unstack_pipeline_params`` once and pass the plain-stack pair."""
+    cfg = getattr(model, "config", None)
+    if cfg is None or getattr(cfg, "pipeline_stages", 1) <= 1:
+        return model, params
+    import dataclasses
+
+    from frl_distributed_ml_scaffold_tpu.models.gpt import (
+        unstack_pipeline_params,
+    )
+
+    plain = type(model)(
+        config=dataclasses.replace(cfg, pipeline_stages=1),
+        policy=model.policy,
+    )
+    return plain, unstack_pipeline_params(cfg, params)
+
+
 def generate(
     model: Any,
     params: Any,
@@ -75,6 +100,7 @@ def generate(
     ``jax.jit(partial(generate, model, ...), static_argnames=...)`` or just
     call it; the two inner ``apply`` calls are where the time goes.
     """
+    model, params = _plain_stack(model, params)
     cfg = model.config
     b, tp = prompt.shape
     if tp + max_new_tokens > cfg.seq_len:
@@ -168,6 +194,7 @@ def beam_search(
     bias. The returned score is the ranked quantity (raw sum when
     alpha=0).
     """
+    model, params = _plain_stack(model, params)
     cfg = model.config
     b, tp = prompt.shape
     w = num_beams

@@ -66,6 +66,45 @@ def gpt_tp_rules(pipelined: bool = False, circular: bool = False) -> PartitionRu
     return PartitionRules(rules=rules)
 
 
+def unstack_pipeline_params(cfg: GPTConfig, params):
+    """Restack pipeline-trained block params into the plain-stack layout.
+
+    Pipeline training stores block weights stage-stacked — GPipe as
+    ``pipeline/ticks/blocks`` leaves ``[S, L/S, ...]``, circular as
+    ``pipeline/blocks`` leaves ``[v, S, L/(S*v), ...]`` — while the decode
+    path's ``nn.scan`` stack expects ``blocks`` leaves ``[L, ...]``. Both
+    stacked layouts enumerate layers in row-major order of their leading
+    dims (stage j holds contiguous layers; circular virtual stage
+    ``r*S + j`` is row ``[r, j]``), so the restack is a pure reshape per
+    leaf — no transpose, no new compute path. Returns a params tree a
+    ``pipeline_stages=1`` model of the same config applies directly.
+    """
+    if "pipeline" not in params:
+        raise ValueError(
+            "params carry no 'pipeline' subtree — already plain-stacked?"
+        )
+    pipe = params["pipeline"]
+    # GPipe nests under the scanned tick module; circular owns the stacked
+    # pytree directly.
+    blocks = pipe["ticks"]["blocks"] if "ticks" in pipe else pipe["blocks"]
+    lead = 2 if "ticks" in pipe else 3
+    L = cfg.num_layers
+
+    def restack(leaf):
+        import numpy as np
+
+        if int(np.prod(leaf.shape[:lead])) != L:
+            raise ValueError(
+                f"stacked leaf {leaf.shape} does not fold into "
+                f"{L} layers ({lead} leading dims)"
+            )
+        return leaf.reshape((L,) + leaf.shape[lead:])
+
+    out = {k: v for k, v in params.items() if k != "pipeline"}
+    out["blocks"] = jax.tree.map(restack, blocks)
+    return out
+
+
 def _masked_dense_attention(q, k, v, mask):
     """Dense attention with an explicit [Tq, Tk] mask, fp32 softmax — the
     same numerics as ops.dense_attention, used by the KV-cache decode path
@@ -252,9 +291,11 @@ class GPT(nn.Module):
 
         if decode and cfg.pipeline_stages > 1:
             raise NotImplementedError(
-                "KV-cache decoding runs on the plain layer stack; set "
-                "pipeline_stages=1 for generation (pipeline parallelism is "
-                "a training-throughput schedule)"
+                "KV-cache decoding runs on the plain layer stack (pipeline "
+                "parallelism is a training-throughput schedule). "
+                "models.generation.generate/beam_search restack pipeline "
+                "params automatically (unstack_pipeline_params); only a "
+                "direct apply(decode=True) needs pipeline_stages=1"
             )
         if cfg.pipeline_stages > 1:
             # flash/ring/ulysses open their own shard_map regions; the
