@@ -178,6 +178,17 @@ ALL_CONFIGS = [
          "model.attention=flash", "model.lm_loss_chunk=128"],
         10,
     ),
+    (
+        # On-chip MoE protocol line (SURVEY C9): single chip has no expert
+        # axis to shard (mesh.expert=1 — EP itself is sim-verified), but
+        # the grouped GSEC dispatch, capacity routing, z-loss, and the
+        # stacked-expert FFN einsums all run at real shapes here.
+        "gpt2_moe",
+        ["data.global_batch_size=4", "trainer.grad_accum=1",
+         "model.attention=flash", "model.lm_loss_chunk=128",
+         "mesh.expert=1"],
+        10,
+    ),
     ("ego4d_video_elastic", ["data.global_batch_size=32",
                              "checkpoint.enabled=false"], 10),
 ]

@@ -249,6 +249,13 @@ class MoEConfig:
     top_k: int = 2
     capacity_factor: float = 1.25
     router_aux_loss: float = 0.01
+    # ST-MoE router z-loss coefficient (mean log²-sum-exp of router
+    # logits); 0 disables.
+    router_z_loss: float = 1e-3
+    # Routing groups (GShard GSEC layout): dispatch/combine memory scales
+    # with 1/G and capacity is enforced per group. 0 = auto (the mesh's
+    # batch-shard count, so each data shard routes its own tokens).
+    num_groups: int = 0
 
 
 @dataclass(frozen=True)

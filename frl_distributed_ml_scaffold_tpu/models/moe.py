@@ -3,13 +3,30 @@
 TPU-native formulation: experts live in a single stacked parameter
 (E, D, H) sharded over the ``expert`` mesh axis; token dispatch/combine are
 einsums against one-hot dispatch tensors, so GSPMD lowers the expert
-exchange to ``all_to_all`` on ICI — no manual send/recv. Router math in
-fp32. Capacity-dropped tokens pass through (residual connection carries
-them). Load-balance aux loss per GShard/Switch.
+exchange to ``all_to_all`` on ICI — no manual send/recv.
+
+**Grouped dispatch** (the GShard paper's GSEC layout): the token stream is
+split into G independent routing groups, each with its own capacity
+``C_g = capacity_factor * (N/G) * k / E``. The dispatch/combine tensors are
+``[G, S, E, C_g]`` — total memory ``N * E * C_g``, i.e. **G× smaller** than
+the ungrouped ``[N, E, C]`` formulation (at GPT-2-medium MoE shapes,
+N=4096 / E=64 / cf=1.25 / k=2 → C=160: the ungrouped bf16 dispatch +
+fp32 combine pair is ~252 MB per layer, G=8 cuts it to ~31 MB; measured
+deltas in docs/perf_playbook.md). Groups default to the mesh's
+batch-shard count, so each data shard routes its own tokens and the group
+dim stays batch-sharded through every einsum. Per-group capacity is the
+standard practice trade: a token can be dropped because *its group* is
+over capacity even if another group has room (residual carries it, as with
+any capacity drop).
+
+Router math in fp32. Load-balance aux loss per GShard/Switch over ALL k
+assignment slots, plus the ST-MoE router z-loss (mean log²-sum-exp of the
+router logits) that keeps logits from drifting into bf16-hostile ranges.
 """
 
 from __future__ import annotations
 
+import math
 from typing import Any
 
 import flax.linen as nn
@@ -17,6 +34,29 @@ import jax
 import jax.numpy as jnp
 
 from frl_distributed_ml_scaffold_tpu.config.schema import GPTConfig
+from frl_distributed_ml_scaffold_tpu.dist.mesh import (
+    BATCH_AXES,
+    current_mesh_env,
+)
+
+
+def _num_groups(moe, n: int) -> int:
+    """Routing-group count for ``n`` tokens. Explicit config is honored
+    when it divides ``n``; otherwise (and for auto) ``gcd`` snaps to the
+    nearest divisor — the same module must route full training batches
+    AND the tiny token counts of KV-cache decode steps (n = batch at one
+    token per sequence), where a hard divisibility error would make every
+    grouped-MoE checkpoint un-generatable. Auto (0) follows the mesh's
+    batch sharding so each data shard routes its own tokens."""
+    if moe.num_groups > 0:
+        return math.gcd(n, moe.num_groups)
+    env = current_mesh_env()
+    if env is None:
+        return 1
+    shards = 1
+    for a in BATCH_AXES:
+        shards *= env.mesh.shape.get(a, 1)
+    return math.gcd(n, shards)
 
 
 class MoEMlp(nn.Module):
@@ -32,63 +72,76 @@ class MoEMlp(nn.Module):
         e, k = moe.num_experts, moe.top_k
         b, t, _ = x.shape
         n = b * t
+        g = _num_groups(moe, n)
+        s = n // g
+        capacity = max(1, int(moe.capacity_factor * s * k / e))
         # Cast to the compute dtype here (the dense MLP gets this implicitly
         # from nn.Dense(dtype=...)); expert math below runs in this dtype so
         # the residual sum keeps the block's carry dtype stable under scan.
-        xf = x.reshape(n, d).astype(self.dtype)
+        xf = x.reshape(g, s, d).astype(self.dtype)
 
         # Router (fp32): probabilities over experts per token.
         router_logits = nn.Dense(e, dtype=jnp.float32, name="router")(
             xf.astype(jnp.float32)
         )
-        probs = jax.nn.softmax(router_logits, axis=-1)  # (N, E)
-        gate_vals, gate_idx = jax.lax.top_k(probs, k)  # (N, k)
+        probs = jax.nn.softmax(router_logits, axis=-1)  # (G, S, E)
+        gate_vals, gate_idx = jax.lax.top_k(probs, k)  # (G, S, k)
         gate_vals = gate_vals / jnp.maximum(gate_vals.sum(-1, keepdims=True), 1e-9)
 
-        capacity = max(1, int(moe.capacity_factor * n * k / e))
-
-        # Position-in-expert via cumulative counts, slot by slot.
-        dispatch = jnp.zeros((n, e, capacity), self.dtype)
-        combine = jnp.zeros((n, e, capacity), jnp.float32)
-        prev_counts = jnp.zeros((e,), jnp.int32)
+        # Position-in-expert via per-group cumulative counts, slot by slot
+        # (slot-major: every token's first choice is seated before any
+        # second choice, per GShard).
+        dispatch = jnp.zeros((g, s, e, capacity), self.dtype)
+        combine = jnp.zeros((g, s, e, capacity), jnp.float32)
+        prev_counts = jnp.zeros((g, e), jnp.int32)
         for slot in range(k):
-            onehot = jax.nn.one_hot(gate_idx[:, slot], e, dtype=jnp.int32)  # (N, E)
-            pos = jnp.cumsum(onehot, axis=0) - 1 + prev_counts[None, :]  # (N, E)
-            prev_counts = prev_counts + onehot.sum(axis=0)
-            pos_tok = (pos * onehot).sum(-1)  # (N,)
+            onehot = jax.nn.one_hot(gate_idx[..., slot], e, dtype=jnp.int32)
+            pos = jnp.cumsum(onehot, axis=1) - 1 + prev_counts[:, None, :]
+            prev_counts = prev_counts + onehot.sum(axis=1)
+            pos_tok = (pos * onehot).sum(-1)  # (G, S)
             keep = pos_tok < capacity
-            pos_oh = jax.nn.one_hot(pos_tok, capacity, dtype=self.dtype)  # (N, C)
+            pos_oh = jax.nn.one_hot(pos_tok, capacity, dtype=self.dtype)
             slot_dispatch = (
-                onehot.astype(self.dtype)[:, :, None]
-                * pos_oh[:, None, :]
-                * keep.astype(self.dtype)[:, None, None]
+                onehot.astype(self.dtype)[..., None]
+                * pos_oh[..., None, :]
+                * keep.astype(self.dtype)[..., None, None]
             )
             dispatch = dispatch + slot_dispatch
             combine = combine + slot_dispatch.astype(jnp.float32) * gate_vals[
-                :, slot
-            ].astype(jnp.float32)[:, None, None]
+                ..., slot
+            ].astype(jnp.float32)[..., None, None]
 
-        # Expert computation: stacked params, expert axis shardable.
+        # Expert computation: stacked params, expert axis shardable. The
+        # group dim rides the batch sharding; the E dim the expert axis —
+        # GSPMD turns the dispatch/combine einsums into all_to_all on ICI.
         wi = self.param(
             "wi", nn.initializers.normal(stddev=0.02), (e, d, hidden)
         )
         wo = self.param(
             "wo", nn.initializers.normal(stddev=0.02), (e, hidden, d)
         )
-        expert_in = jnp.einsum("nec,nd->ecd", dispatch, xf)  # all_to_all here
+        expert_in = jnp.einsum("gsec,gsd->egcd", dispatch, xf)  # all_to_all
         h = jax.nn.gelu(
-            jnp.einsum("ecd,edh->ech", expert_in, wi.astype(self.dtype))
+            jnp.einsum("egcd,edh->egch", expert_in, wi.astype(self.dtype))
         )
-        expert_out = jnp.einsum("ech,ehd->ecd", h, wo.astype(self.dtype))
+        expert_out = jnp.einsum("egch,ehd->egcd", h, wo.astype(self.dtype))
         y = jnp.einsum(
-            "nec,ecd->nd", combine.astype(self.dtype), expert_out
+            "gsec,egcd->gsd", combine.astype(self.dtype), expert_out
         )  # and back
 
-        # GShard load-balance loss: E * sum_e(frac_tokens_e * mean_prob_e).
-        frac = jnp.mean(
-            jax.nn.one_hot(gate_idx[:, 0], e, dtype=jnp.float32), axis=0
-        )
-        mean_prob = jnp.mean(probs, axis=0)
+        # GShard load-balance loss, E * sum_e(frac_tokens_e * mean_prob_e),
+        # with frac counting ALL k assignment slots (each slot contributes
+        # 1/k so a perfectly uniform router scores frac_e = 1/E exactly as
+        # in the top-1 form). prev_counts already holds the slot-summed
+        # per-expert counts; gate_idx is integer so frac carries no
+        # gradient either way — aux gradients flow through mean_prob.
+        frac = prev_counts.sum(0).astype(jnp.float32) / (g * s * k)
+        mean_prob = jnp.mean(probs, axis=(0, 1))
         aux = moe.router_aux_loss * e * jnp.sum(frac * mean_prob)
+        # ST-MoE router z-loss: penalizes large router logits (bf16-unsafe
+        # and softmax-saturating) without touching the routing decision.
+        if moe.router_z_loss > 0.0:
+            z = jax.nn.logsumexp(router_logits, axis=-1)  # (G, S)
+            aux = aux + moe.router_z_loss * jnp.mean(z * z)
 
         return y.reshape(b, t, d), aux

@@ -248,8 +248,14 @@ def test_generation_works_with_moe_model():
     from frl_distributed_ml_scaffold_tpu.config.schema import MoEConfig
     from frl_distributed_ml_scaffold_tpu.models.generation import beam_search
 
+    # num_groups=4 does NOT divide the decode-step token count (n = batch
+    # = 2 at one token per sequence): _num_groups must gcd-snap instead of
+    # raising, or grouped-MoE checkpoints could never be sampled.
     model = GPT(
-        GPTConfig(**TINY, moe=MoEConfig(num_experts=4, top_k=2)), FP32
+        GPTConfig(
+            **TINY, moe=MoEConfig(num_experts=4, top_k=2, num_groups=4)
+        ),
+        FP32,
     )
     tokens = jax.random.randint(jax.random.key(4), (2, 6), 0, 64)
     params = jit_init(model, tokens, train=False)["params"]

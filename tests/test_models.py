@@ -476,3 +476,71 @@ def test_ulysses_tp_local_head_divisibility_error():
     q, k, v = _rand_qkv(jax.random.key(4), h=4)
     with pytest.raises(ValueError, match="divisible"):
         ulysses_attention(q, k, v)
+
+
+def test_moe_grouped_dispatch_matches_ungrouped():
+    """GShard grouped routing (the G× dispatch-memory saver) must be a pure
+    re-bucketing: with capacity ample enough that no group drops a token,
+    G=1 and G=4 route every token to the same experts with the same gates,
+    so the block output is identical. Aux differs only through per-group
+    bookkeeping (it must not), so it is asserted equal too."""
+    import dataclasses
+
+    from frl_distributed_ml_scaffold_tpu.models.moe import MoEMlp
+
+    base = tiny_gpt(
+        moe=MoEConfig(
+            num_experts=4, top_k=2, capacity_factor=8.0, num_groups=1
+        )
+    )
+    x = jax.random.normal(jax.random.key(0), (2, 16, 32), jnp.float32)
+
+    def run(cfg):
+        m = MoEMlp(cfg, jnp.float32)
+        variables = jax.jit(
+            lambda v: m.init(jax.random.key(1), v, train=False)
+        )(x)
+        return jax.jit(
+            lambda v, xx: m.apply(v, xx, train=False)
+        )(variables, x)
+
+    y1, aux1 = run(base)
+    cfg4 = dataclasses.replace(
+        base, moe=dataclasses.replace(base.moe, num_groups=4)
+    )
+    y4, aux4 = run(cfg4)
+    np.testing.assert_allclose(y1, y4, atol=1e-6, rtol=1e-6)
+    np.testing.assert_allclose(float(aux1), float(aux4), rtol=1e-6)
+
+
+def test_moe_router_z_loss_penalizes_large_logits():
+    """The z-loss term must grow with router-logit magnitude (its whole
+    point) and vanish when disabled."""
+    import dataclasses
+
+    from frl_distributed_ml_scaffold_tpu.models.moe import MoEMlp
+
+    cfg = tiny_gpt(
+        moe=MoEConfig(num_experts=4, top_k=2, router_z_loss=1e-3)
+    )
+    m = MoEMlp(cfg, jnp.float32)
+    x = jax.random.normal(jax.random.key(0), (2, 16, 32), jnp.float32)
+    variables = jax.jit(lambda v: m.init(jax.random.key(1), v, train=False))(x)
+    apply = jax.jit(lambda v, xx: m.apply(v, xx, train=False))
+    _, aux = apply(variables, x)
+
+    # Scale the router kernel: logits grow, z² grows, aux must grow.
+    big = jax.tree.map(lambda l: l, variables)
+    big = {"params": dict(big["params"])}
+    router = dict(big["params"]["router"])
+    router["kernel"] = router["kernel"] * 50.0
+    big["params"]["router"] = router
+    _, aux_big = apply(big, x)
+    assert float(aux_big) > float(aux)
+
+    cfg0 = dataclasses.replace(
+        cfg, moe=dataclasses.replace(cfg.moe, router_z_loss=0.0)
+    )
+    m0 = MoEMlp(cfg0, jnp.float32)
+    _, aux0 = jax.jit(lambda v, xx: m0.apply(v, xx, train=False))(variables, x)
+    assert float(aux) > float(aux0)  # the z term is there and positive

@@ -1,0 +1,181 @@
+#!/usr/bin/env python
+"""Activation-residency audit of the pipeline schedules (SURVEY C7).
+
+Answers, with jaxpr-level residual accounting, the question behind 1F1B:
+how much activation memory must the backward hold under each schedule?
+The scan-autodiff GPipe/circular formulation saves every tick's stage
+activations until the reverse timeline consumes them — O(v·M + S) tick
+buffers — where a hand-scheduled 1F1B holds O(S) microbatches in flight
+per stage. This tool measures the actual forward→backward residuals of
+the REAL loss function (``jax._src.ad_checkpoint.saved_residuals`` — the
+same accounting ``jax.ad_checkpoint.print_saved_residuals`` prints, and
+immune to XLA:CPU's CSE which silently undoes recompute in
+``memory_analysis``):
+
+    python tools/pp_memory_audit.py [--layers 8] [--batch 16] [...]
+
+Reported per schedule: total residual bytes, the per-tick-stacked subset
+(leading dim = v·M+S-1 — the part 1F1B eliminates), everything else
+(embeddings/head — schedule-independent), and the per-stage residency
+after ``pipe`` sharding. ``--remat full`` shows jax.checkpoint collapsing
+top-level residuals to the inputs (peak then moves inside the recompute).
+Emits one JSON line per variant plus a table; docs/perf_playbook.md
+records the conclusions.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _residual_bytes(res) -> tuple[int, dict]:
+    by_shape: dict = {}
+    total = 0
+    for aval, _src in res:
+        if not hasattr(aval, "shape"):
+            continue
+        nbytes = int(aval.size) * aval.dtype.itemsize
+        total += nbytes
+        key = tuple(aval.shape)
+        by_shape[key] = by_shape.get(key, 0) + nbytes
+    return total, by_shape
+
+
+def audit_one(args, sched: str, overrides: list[str], remat: str) -> dict:
+    import jax
+    import jax.numpy as jnp
+    from jax._src.ad_checkpoint import saved_residuals
+
+    from frl_distributed_ml_scaffold_tpu.config import apply_overrides, get_config
+    from frl_distributed_ml_scaffold_tpu.trainer.loop import Trainer
+    from frl_distributed_ml_scaffold_tpu.trainer.tasks import example_input
+    from frl_distributed_ml_scaffold_tpu.trainer.train_step import _remat_wrap
+
+    base = [
+        f"model.num_layers={args.layers}",
+        f"model.hidden_dim={args.hidden}",
+        f"model.num_heads={args.heads}",
+        f"model.seq_len={args.seq}",
+        f"model.vocab_size={args.vocab}",
+        f"data.seq_len={args.seq}",
+        f"data.vocab_size={args.vocab}",
+        f"data.global_batch_size={args.batch}",
+        "model.lm_loss_chunk=0",
+        "trainer.grad_accum=1",
+        "checkpoint.enabled=false",
+        "data.prefetch=0",
+        "precision.policy=bf16_mixed",
+        f"trainer.remat={remat}",
+    ]
+    cfg = apply_overrides(get_config("gpt2_medium_zero1"), base + overrides)
+    trainer = Trainer(cfg)
+    example = {
+        k: jnp.asarray(v)
+        for k, v in example_input(
+            cfg.data, cfg.model, batch_size=cfg.data.global_batch_size
+        ).items()
+    }
+    wrapped = _remat_wrap(trainer.loss_fn, remat)
+
+    def scalar_loss(params):
+        loss, _ = wrapped(
+            params, trainer.state_shapes.extras, example,
+            jax.random.key(0), True,
+        )
+        return loss
+
+    res = trainer._mesh_scoped(saved_residuals)(
+        scalar_loss, trainer.state_shapes.params
+    )
+    total, by_shape = _residual_bytes(res)
+
+    s = cfg.model.pipeline_stages
+    v = max(1, cfg.model.pipeline_circular_repeat) if s > 1 else 1
+    m = cfg.model.pipeline_microbatches or s
+    ticks = v * m + s - 1 if s > 1 else 0
+    # Param-shaped residuals (the weights the backward re-reads) are
+    # resident state, not schedule cost — exclude them from the
+    # activation figure by subtracting exact param-leaf sizes.
+    param_bytes = sum(
+        int(l.size) * l.dtype.itemsize
+        for l in jax.tree.leaves(trainer.state_shapes.params)
+    )
+    ticked = sum(
+        b for shape, b in by_shape.items() if ticks and shape[:1] == (ticks,)
+    )
+    rec = {
+        "schedule": sched,
+        "remat": remat,
+        "ticks": ticks,
+        "residual_mb": round(total / 1e6, 1),
+        "residual_minus_params_mb": round((total - param_bytes) / 1e6, 1),
+        "tick_stacked_mb": round(ticked / 1e6, 1),
+        "other_mb": round((total - param_bytes - ticked) / 1e6, 1),
+        # Tick-stacked residuals carry [ticks, S, mb, ...] with the S dim
+        # pipe-sharded: per-stage residency is the 1/S slice.
+        "tick_stacked_per_stage_mb": round(
+            ticked / max(1, s) / 1e6, 1
+        ),
+    }
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--layers", type=int, default=8)
+    ap.add_argument("--hidden", type=int, default=512)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=512)
+    ap.add_argument("--vocab", type=int, default=1024)
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--stages", type=int, default=4)
+    ap.add_argument("--microbatches", type=int, default=8)
+    ap.add_argument("--repeat", type=int, default=2)
+    ap.add_argument("--remat", default="none", choices=["none", "dots", "full"])
+    args = ap.parse_args()
+    os.environ.setdefault(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8"
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    variants = [
+        ("plain", ["model.pipeline_stages=1", "mesh.pipe=1", "mesh.data=8"]),
+        (
+            "gpipe",
+            [f"model.pipeline_stages={args.stages}",
+             f"model.pipeline_microbatches={args.microbatches}",
+             f"mesh.pipe={args.stages}", "mesh.data=2"],
+        ),
+        (
+            "circular",
+            [f"model.pipeline_stages={args.stages}",
+             f"model.pipeline_microbatches={args.microbatches}",
+             f"model.pipeline_circular_repeat={args.repeat}",
+             f"mesh.pipe={args.stages}", "mesh.data=2"],
+        ),
+    ]
+    rows = [audit_one(args, s, o, args.remat) for s, o in variants]
+    print(
+        f"\n{'schedule':10s} {'ticks':>5s} {'resid-params MB':>16s} "
+        f"{'tick-stacked MB':>16s} {'per-stage MB':>13s}"
+    )
+    for r in rows:
+        print(
+            f"{r['schedule']:10s} {r['ticks']:5d} "
+            f"{r['residual_minus_params_mb']:16.1f} "
+            f"{r['tick_stacked_mb']:16.1f} "
+            f"{r['tick_stacked_per_stage_mb']:13.1f}"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
