@@ -1,11 +1,13 @@
-"""ImageNet-shape loader: real per-class folders if present, else synthetic.
+"""ImageNet-shape loader: pre-decoded ``.npy`` shards if present, else synthetic.
 
-A real ImageNet copy would need JPEG decode throughput beyond what Python
-gives (SURVEY §7 hard part 5); in this zero-egress image, no ImageNet exists,
-so the synthetic class-prototype generator provides the same shapes/dtypes
-at memory speed — benchmark numbers then measure the chip, not the loader.
-If ``data_dir`` points at a directory of pre-decoded ``.npy`` shards
-(``{split}_images_XXX.npy`` / ``{split}_labels_XXX.npy``), those are used.
+Per-step JPEG decode on the host would starve the chip (SURVEY §7 hard
+part 5), so decode happens OFFLINE: ``tools/decode_imagenet.py`` turns a
+raw per-class JPEG tree into ``{split}_images_XXX.npy`` (float32 [0,1] or
+uint8 0-255) + ``{split}_labels_XXX.npy`` shards, which this loader
+memmaps and gathers per batch. In this zero-egress image no ImageNet
+exists, so the synthetic class-prototype generator provides the same
+shapes/dtypes at memory speed — benchmark numbers then measure the chip,
+not the loader.
 """
 
 from __future__ import annotations
@@ -55,6 +57,10 @@ class ImageNet:
         rng = np.random.default_rng((self._seed, step, host_offset))
         idx = np.sort(rng.integers(0, self._corpus.n, size=batch_size))
         size = self.cfg.image_size
+        # uint8 shards (tools/decode_imagenet.py --dtype uint8, 1/4 the
+        # disk) are converted + scaled to [0,1] float32 INSIDE the gather
+        # (native.gather_rows) — stored dtype never changes training
+        # statistics.
         x, labels = self._corpus.gather(idx)
         # Always through the augment kernel: normalize + (train) flip apply
         # even when stored size == input size — storage size must never

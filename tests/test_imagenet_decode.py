@@ -1,0 +1,97 @@
+"""JPEG → shard producer (tools/decode_imagenet.py) + loader round-trip.
+
+The encode/decode halves run in a subprocess (TensorFlow is IO-only
+tooling and must never load into the training/test process); the loader
+assertions run here on the produced shards — the same contract a real
+ImageNet copy would exercise (SURVEY C16).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_PRODUCER = textwrap.dedent(
+    """
+    import os, sys
+    import numpy as np
+    import tensorflow as tf
+
+    raw, out = sys.argv[1], sys.argv[2]
+    rng = np.random.default_rng(0)
+    for ci, cls in enumerate(["n01440764", "n01443537"]):
+        d = os.path.join(raw, "train", cls)
+        os.makedirs(d, exist_ok=True)
+        for i in range(6):
+            # Distinct mean per class so labels are checkable post-decode.
+            img = np.full((40 + 8 * i, 36, 3), 40 + 150 * ci, np.uint8)
+            img += rng.integers(0, 20, img.shape, dtype=np.uint8)
+            tf.io.write_file(
+                os.path.join(d, f"img_{i}.JPEG"),
+                tf.io.encode_jpeg(tf.constant(img)),
+            )
+    sys.argv = [
+        "decode_imagenet.py", raw, out, "--split", "train",
+        "--size", "32", "--shard-items", "5", "--dtype", "uint8",
+    ]
+    sys.path.insert(0, os.path.join(%r, "tools"))
+    import decode_imagenet
+    raise SystemExit(decode_imagenet.main())
+    """
+) % (REPO_ROOT,)
+
+
+@pytest.fixture(scope="module")
+def shard_dir(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("imagenet_jpeg")
+    raw, out = str(tmp / "raw"), str(tmp / "shards")
+    env = {**os.environ, "CUDA_VISIBLE_DEVICES": "-1",
+           "TF_CPP_MIN_LOG_LEVEL": "2"}
+    env.pop("XLA_FLAGS", None)  # keep TF from parsing jax's sim-device flag
+    proc = subprocess.run(
+        [sys.executable, "-c", _PRODUCER, raw, out],
+        capture_output=True, text=True, timeout=240, env=env, cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    return out
+
+
+def test_producer_emits_paired_shards(shard_dir):
+    xs = sorted(f for f in os.listdir(shard_dir) if "images" in f)
+    ys = sorted(f for f in os.listdir(shard_dir) if "labels" in f)
+    assert len(xs) == len(ys) == 3  # 12 images / 5 per shard
+    x0 = np.load(os.path.join(shard_dir, xs[0]))
+    assert x0.shape == (5, 32, 32, 3) and x0.dtype == np.uint8
+    meta = json.load(open(os.path.join(shard_dir, "train_meta.json")))
+    assert meta["images"] == 12 and meta["classes"] == 2
+
+
+def test_loader_round_trip_uint8_scaling(shard_dir):
+    from frl_distributed_ml_scaffold_tpu.config.schema import DataConfig
+
+    from frl_distributed_ml_scaffold_tpu.data.imagenet import ImageNet
+
+    cfg = DataConfig(
+        name="imagenet", image_size=32, num_classes=2, data_dir=shard_dir,
+        global_batch_size=8,
+    )
+    ds = ImageNet(cfg, split="train")
+    assert not ds.is_synthetic
+    batch = ds.batch(0, 8)
+    x, y = batch["image"], batch["label"]
+    assert x.shape == (8, 32, 32, 3) and x.dtype == np.float32
+    assert set(np.unique(y)) <= {0, 1}
+    # uint8 shards were rescaled to [0,1] BEFORE ImageNet normalization:
+    # values land in the standardized range, not 0-255.
+    assert np.abs(x).max() < 10.0
+    # The two classes were encoded with far-apart pixel means; after
+    # normalization their per-image means must still separate by label.
+    means = x.mean(axis=(1, 2, 3))
+    if (y == 0).any() and (y == 1).any():
+        assert means[y == 1].min() > means[y == 0].max()

@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Offline JPEG → ``.npy`` shard producer for the ImageNet loader (C16).
+
+The training-path loaders read pre-decoded, fixed-shape shards
+(``{split}_images_XXX.npy`` + ``{split}_labels_XXX.npy`` — data/shards.py)
+because per-step JPEG decode on the host would starve the chip (SURVEY §7
+hard part 5). This tool is the missing producer half for a real ImageNet
+copy: it walks the standard per-class layout
+
+    <raw_dir>/<split>/<wnid_or_class_name>/*.JPEG
+
+decodes with TensorFlow's C++ JPEG decoder (tf is already in the image —
+no new dependency; tf is used for IO only, nothing touches the training
+path), resizes the short side to ``--size`` and center-crops to
+``size x size``, and writes shards the loader memmaps directly:
+
+    python tools/decode_imagenet.py <raw_dir> <out_dir> --split train \
+        [--size 256] [--shard-items 1024] [--dtype uint8|float32] [--limit N]
+
+Labels are the sorted class-directory order (the standard wnid->index
+convention). ``--dtype uint8`` stores raw 0-255 pixels at 1/4 the disk of
+float32; the loader rescales to [0,1] on gather before the augment kernel
+normalizes, so stored dtype never changes training statistics.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def iter_decoded(files, size: int):
+    """Yield center-cropped ``size x size x 3`` float32 [0,1] images."""
+    import tensorflow as tf  # IO-only; never imported by the training path
+
+    for path in files:
+        data = tf.io.read_file(path)
+        img = tf.io.decode_image(
+            data, channels=3, expand_animations=False
+        )  # JPEG/PNG/BMP; uint8 HWC
+        h = tf.shape(img)[0]
+        w = tf.shape(img)[1]
+        short = tf.minimum(h, w)
+        scale = tf.cast(size, tf.float32) / tf.cast(short, tf.float32)
+        nh = tf.cast(tf.math.ceil(tf.cast(h, tf.float32) * scale), tf.int32)
+        nw = tf.cast(tf.math.ceil(tf.cast(w, tf.float32) * scale), tf.int32)
+        img = tf.image.resize(img, (nh, nw), antialias=True)  # float32 0-255
+        top = (nh - size) // 2
+        left = (nw - size) // 2
+        img = img[top : top + size, left : left + size, :]
+        yield np.clip(np.asarray(img) / 255.0, 0.0, 1.0).astype(np.float32)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("raw_dir", help="root holding <split>/<class>/*.JPEG")
+    ap.add_argument("out_dir")
+    ap.add_argument("--split", default="train")
+    ap.add_argument("--size", type=int, default=256,
+                    help="stored side; must be >= data.image_size")
+    ap.add_argument("--shard-items", type=int, default=1024)
+    ap.add_argument("--dtype", default="uint8", choices=["uint8", "float32"])
+    ap.add_argument("--seed", type=int, default=0,
+                    help="class-mixing shuffle of the file order")
+    ap.add_argument("--limit", type=int, default=0,
+                    help="stop after N images (0 = all; for smoke runs)")
+    args = ap.parse_args()
+
+    split_dir = os.path.join(args.raw_dir, args.split)
+    classes = sorted(
+        d for d in os.listdir(split_dir)
+        if os.path.isdir(os.path.join(split_dir, d))
+    )
+    if not classes:
+        print(f"no class directories under {split_dir}", file=sys.stderr)
+        return 2
+    pairs = []  # (path, label)
+    for label, cls in enumerate(classes):
+        for p in sorted(
+            glob.glob(os.path.join(split_dir, cls, "*"))
+        ):
+            if os.path.isfile(p):
+                pairs.append((p, label))
+    rng = np.random.default_rng(args.seed)
+    rng.shuffle(pairs)
+    if args.limit:
+        pairs = pairs[: args.limit]
+
+    os.makedirs(args.out_dir, exist_ok=True)
+    buf_x, buf_y, shard_idx, written = [], [], 0, 0
+
+    def flush():
+        nonlocal buf_x, buf_y, shard_idx
+        if not buf_x:
+            return
+        x = np.stack(buf_x)
+        np.save(
+            os.path.join(
+                args.out_dir, f"{args.split}_images_{shard_idx:03d}.npy"
+            ),
+            x,
+        )
+        np.save(
+            os.path.join(
+                args.out_dir, f"{args.split}_labels_{shard_idx:03d}.npy"
+            ),
+            np.asarray(buf_y, np.int32),
+        )
+        shard_idx += 1
+        buf_x, buf_y = [], []
+
+    files = [p for p, _ in pairs]
+    labels = [y for _, y in pairs]
+    for img, y in zip(iter_decoded(files, args.size), labels):
+        if args.dtype == "uint8":
+            # Convert per image, not at flush: a float32 shard buffer
+            # would hold 4x the bytes of the uint8 it becomes.
+            img = np.round(img * 255.0).astype(np.uint8)
+        buf_x.append(img)
+        buf_y.append(y)
+        written += 1
+        if len(buf_x) >= args.shard_items:
+            flush()
+    flush()
+    meta = {
+        "split": args.split, "images": written, "classes": len(classes),
+        "size": args.size, "dtype": args.dtype, "shards": shard_idx,
+        "class_names": classes,
+    }
+    with open(
+        os.path.join(args.out_dir, f"{args.split}_meta.json"), "w"
+    ) as fh:
+        json.dump(meta, fh, indent=1)
+    print(json.dumps({k: v for k, v in meta.items() if k != "class_names"}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
