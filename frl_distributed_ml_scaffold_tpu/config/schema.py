@@ -294,6 +294,13 @@ class GPTConfig:
     # from (S-1)/(M+S-1) to (S-1)/(repeat*M + S-1) at the price of rotating
     # activations through the stages ``repeat`` times. 1 = plain GPipe.
     pipeline_circular_repeat: int = 1
+    # Stage-granular rematerialization — 1F1B's activation residency in the
+    # one-program GSPMD schedule: the backward saves only per-tick stage
+    # BOUNDARY activations and recomputes stage internals (one extra stage
+    # forward each, the usual remat trade). Finer-grained than
+    # trainer.remat=full (which recomputes the whole pipeline timeline
+    # inside the backward); composes with either schedule above.
+    pipeline_stage_remat: bool = False
 
 
 @dataclass(frozen=True)

@@ -317,6 +317,7 @@ class GPT(nn.Module):
                 num_layers=cfg.num_layers,
                 num_stages=cfg.pipeline_stages,
                 num_microbatches=effective_microbatches(cfg),
+                stage_remat=cfg.pipeline_stage_remat,
                 name="pipeline",
                 **({"repeat": v} if v > 1 else {}),
             )

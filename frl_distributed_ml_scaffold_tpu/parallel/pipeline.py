@@ -98,6 +98,7 @@ class _PipelineTick(nn.Module):
     block_args: tuple
     num_stages: int
     layers_per_stage: int
+    stage_remat: bool = False
 
     @nn.compact
     def __call__(self, carry, xs):
@@ -114,6 +115,17 @@ class _PipelineTick(nn.Module):
             variable_axes={"params": 0},
             split_rngs={"params": True, "dropout": True},
         )
+        if self.stage_remat:
+            # Stage-granular rematerialization — the 1F1B memory profile
+            # inside the one-program GSPMD formulation: autodiff saves only
+            # each tick's stage-BOUNDARY inputs (the scan carry) and
+            # recomputes stage internals in the backward, so activation
+            # residency drops from O(ticks · per-stage internals) to
+            # O(ticks · boundary) + one stage's internals transiently
+            # (measured: tools/pp_memory_audit.py; docs/perf_playbook.md).
+            # prevent_cse=False: the tick scan already blocks CSE, and the
+            # guard would only inhibit XLA optimizations.
+            stage = nn.remat(stage, prevent_cse=False)
         body = nn.vmap(
             stage,
             variable_axes={"params": 0},
@@ -152,6 +164,7 @@ class SpmdPipeline(nn.Module):
     num_layers: int
     num_stages: int
     num_microbatches: int
+    stage_remat: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array, aux0: jax.Array):
@@ -187,6 +200,7 @@ class SpmdPipeline(nn.Module):
             self.block_args,
             s,
             self.num_layers // s,
+            self.stage_remat,
             name="ticks",
         )
         buf0 = _constrain(
@@ -242,6 +256,7 @@ class CircularSpmdPipeline(nn.Module):
     num_stages: int
     num_microbatches: int
     repeat: int
+    stage_remat: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array, aux0: jax.Array):
@@ -300,7 +315,7 @@ class CircularSpmdPipeline(nn.Module):
         has_drop = self.has_rng("dropout")
         drop_rng = self.make_rng("dropout") if has_drop else None
 
-        def select_params(r_vec):
+        def select_params(stacked_, r_vec):
             """leaf[v, s, ...] -> [s, ...] with out[j] = leaf[r_vec[j], j]."""
             env = current_mesh_env()
 
@@ -324,7 +339,7 @@ class CircularSpmdPipeline(nn.Module):
                     picked, NamedSharding(env.mesh, spec)
                 )
 
-            return jax.tree.map(sel, stacked)
+            return jax.tree.map(sel, stacked_)
 
         def apply_stage(p, slot, rng):
             rngs = {"dropout": rng} if has_drop else None
@@ -333,7 +348,20 @@ class CircularSpmdPipeline(nn.Module):
             )
             return y, aux
 
-        vmapped_apply = jax.vmap(apply_stage, spmd_axis_name="pipe")
+        def tick_compute(stacked_, r_vec, buf, rngs_t):
+            params_t = select_params(stacked_, r_vec)
+            return jax.vmap(apply_stage, spmd_axis_name="pipe")(
+                params_t, buf, rngs_t
+            )
+
+        if self.stage_remat:
+            # Same stage-granular remat as the GPipe class: save only the
+            # stage-boundary carry per tick, recompute internals in bwd.
+            # Param SELECTION sits inside the checkpointed region — done
+            # outside, every tick's gathered per-stage params ([ticks, S,
+            # ...] ~ the model over again) would be saved as residuals;
+            # inside, the backward re-gathers from the resident stack.
+            tick_compute = jax.checkpoint(tick_compute, prevent_cse=False)
 
         x_mb = _constrain(x.reshape((m, mb) + x.shape[1:]), None, BATCH_AXES)
 
@@ -353,14 +381,13 @@ class CircularSpmdPipeline(nn.Module):
             offs = t - jnp.arange(s)
             r_vec = jnp.clip(offs // m, 0, v - 1).astype(jnp.int32)
             valid = (offs >= 0) & (offs < v * m)
-            params_t = select_params(r_vec)
             if has_drop:
                 rngs_t = jax.vmap(
                     lambda j: jax.random.fold_in(jax.random.fold_in(drop_rng, t), j)
                 )(jnp.arange(s))
             else:
                 rngs_t = jnp.zeros((s,), jnp.uint32)  # unused placeholder
-            out, aux_delta = vmapped_apply(params_t, buf, rngs_t)
+            out, aux_delta = tick_compute(stacked, r_vec, buf, rngs_t)
             aux_acc = aux_acc + jnp.sum(aux_delta * valid.astype(jnp.float32))
             y = out[s - 1]
             queue = _constrain(

@@ -33,17 +33,23 @@ import sys
 
 import numpy as np
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_IMAGE_EXTS = (".jpeg", ".jpg", ".png", ".bmp")
 
 
-def iter_decoded(files, size: int):
-    """Yield center-cropped ``size x size x 3`` float32 [0,1] images."""
+def iter_decoded(files, labels, size: int):
+    """Yield ``(size x size x 3 float32 [0,1] image, label)`` pairs.
+
+    A ``tf.data`` pipeline so the C++ JPEG decoder runs on all cores
+    (``num_parallel_calls=AUTOTUNE``) — a sequential per-image loop would
+    take hours over a real train split. Labels travel WITH their file
+    through the pipeline, so ``ignore_errors`` (undecodable files are
+    skipped with tf's warning, not a crash) can never misalign pairs.
+    """
     import tensorflow as tf  # IO-only; never imported by the training path
 
-    for path in files:
-        data = tf.io.read_file(path)
+    def decode(path, label):
         img = tf.io.decode_image(
-            data, channels=3, expand_animations=False
+            tf.io.read_file(path), channels=3, expand_animations=False
         )  # JPEG/PNG/BMP; uint8 HWC
         h = tf.shape(img)[0]
         w = tf.shape(img)[1]
@@ -55,7 +61,18 @@ def iter_decoded(files, size: int):
         top = (nh - size) // 2
         left = (nw - size) // 2
         img = img[top : top + size, left : left + size, :]
-        yield np.clip(np.asarray(img) / 255.0, 0.0, 1.0).astype(np.float32)
+        img = tf.clip_by_value(img / 255.0, 0.0, 1.0)
+        img.set_shape((size, size, 3))
+        return img, label
+
+    ds = tf.data.Dataset.from_tensor_slices(
+        (list(files), np.asarray(labels, np.int32))
+    )
+    ds = ds.map(decode, num_parallel_calls=tf.data.AUTOTUNE)
+    ds = ds.ignore_errors(log_warning=True)
+    ds = ds.prefetch(tf.data.AUTOTUNE)
+    for img, label in ds.as_numpy_iterator():
+        yield img.astype(np.float32), int(label)
 
 
 def main() -> int:
@@ -82,12 +99,20 @@ def main() -> int:
         print(f"no class directories under {split_dir}", file=sys.stderr)
         return 2
     pairs = []  # (path, label)
+    skipped = 0
     for label, cls in enumerate(classes):
         for p in sorted(
             glob.glob(os.path.join(split_dir, cls, "*"))
         ):
-            if os.path.isfile(p):
+            # Extension filter: a real tree holds .DS_Store/checksums/
+            # READMEs alongside images; they must be skipped here, not
+            # crash the decoder hours in.
+            if os.path.isfile(p) and p.lower().endswith(_IMAGE_EXTS):
                 pairs.append((p, label))
+            elif os.path.isfile(p):
+                skipped += 1
+    if skipped:
+        print(f"skipping {skipped} non-image file(s)", file=sys.stderr)
     rng = np.random.default_rng(args.seed)
     rng.shuffle(pairs)
     if args.limit:
@@ -118,7 +143,7 @@ def main() -> int:
 
     files = [p for p, _ in pairs]
     labels = [y for _, y in pairs]
-    for img, y in zip(iter_decoded(files, args.size), labels):
+    for img, y in iter_decoded(files, labels, args.size):
         if args.dtype == "uint8":
             # Convert per image, not at flush: a float32 shard buffer
             # would hold 4x the bytes of the uint8 it becomes.

@@ -148,21 +148,21 @@ def main() -> int:
 
     jax.config.update("jax_platforms", "cpu")
 
+    gpipe_ov = [
+        f"model.pipeline_stages={args.stages}",
+        f"model.pipeline_microbatches={args.microbatches}",
+        f"mesh.pipe={args.stages}", "mesh.data=2",
+    ]
+    circ_ov = gpipe_ov + [
+        f"model.pipeline_circular_repeat={args.repeat}",
+    ]
+    sr = ["model.pipeline_stage_remat=true"]
     variants = [
         ("plain", ["model.pipeline_stages=1", "mesh.pipe=1", "mesh.data=8"]),
-        (
-            "gpipe",
-            [f"model.pipeline_stages={args.stages}",
-             f"model.pipeline_microbatches={args.microbatches}",
-             f"mesh.pipe={args.stages}", "mesh.data=2"],
-        ),
-        (
-            "circular",
-            [f"model.pipeline_stages={args.stages}",
-             f"model.pipeline_microbatches={args.microbatches}",
-             f"model.pipeline_circular_repeat={args.repeat}",
-             f"mesh.pipe={args.stages}", "mesh.data=2"],
-        ),
+        ("gpipe", gpipe_ov),
+        ("gpipe+sr", gpipe_ov + sr),
+        ("circular", circ_ov),
+        ("circular+sr", circ_ov + sr),
     ]
     rows = [audit_one(args, s, o, args.remat) for s, o in variants]
     print(
