@@ -98,7 +98,7 @@ class PrecisionConfig:
 
 @dataclass(frozen=True)
 class OptimizerConfig:
-    name: str = "adamw"  # adamw | sgd | adam | adafactor | lion
+    name: str = "adamw"  # adamw | sgd | adam | adafactor | lion | fused_adamw
     learning_rate: float = 1e-3
     warmup_steps: int = 0
     schedule: str = "constant"  # constant | cosine | linear | wsd

@@ -97,6 +97,19 @@ class Trainer:
                     f"model.{attr}={m_v}; labels out of the model's range "
                     "silently NaN the loss — override both together"
                 )
+        if cfg.optimizer.name == "fused_adamw" and (
+            cfg.parallel.opt_sharding != "like_params"
+            or cfg.parallel.param_sharding != "replicated"
+        ):
+            # The fused kernel is opaque to GSPMD: sharded mu/nu/params
+            # would be silently all-gathered every step, defeating the
+            # exact memory savings ZeRO/FSDP exist for (ops/fused_adamw.py
+            # honesty contract) — refuse rather than de-optimize quietly.
+            raise ValueError(
+                "optimizer.name=fused_adamw requires replicated state "
+                "(parallel.param_sharding=replicated, "
+                "opt_sharding=like_params); use adamw with ZeRO/FSDP"
+            )
         self.env = mesh_env if mesh_env is not None else build_mesh(cfg.mesh)
         self.policy = get_policy(cfg.precision)
         self.model = create_model(cfg.model, self.policy)

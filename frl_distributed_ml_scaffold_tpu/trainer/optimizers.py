@@ -81,6 +81,33 @@ def make_optimizer(
         parts.append(optax.clip_by_global_norm(cfg.grad_clip_norm))
     # b2=None -> each optimizer's canonical default (schema contract).
     adam_b2 = 0.999 if cfg.b2 is None else cfg.b2
+    if cfg.name == "fused_adamw":
+        # Single-Pallas-pass AdamW (ops/fused_adamw.py, BACKLOG-5
+        # experiment). Returned UNCHAINED: optax.chain would hide the
+        # fused_apply fast path the train step dispatches on. grad clip is
+        # a global-norm reduction across the whole tree — inherently a
+        # separate pass — so the combination is refused rather than
+        # silently de-fused.
+        if cfg.grad_clip_norm is not None:
+            raise ValueError(
+                "optimizer.name=fused_adamw does not compose with "
+                "grad_clip_norm (global-norm clipping defeats the "
+                "single-pass fusion); use adamw"
+            )
+        from frl_distributed_ml_scaffold_tpu.ops.fused_adamw import (
+            fused_adamw,
+        )
+
+        return (
+            fused_adamw(
+                schedule,
+                b1=cfg.b1,
+                b2=adam_b2,
+                eps=cfg.eps,
+                weight_decay=cfg.weight_decay,
+            ),
+            schedule,
+        )
     if cfg.name == "adamw":
         parts.append(
             optax.adamw(

@@ -144,10 +144,17 @@ def make_train_step(
             import jax.memory as jm
 
             opt_state = jax.device_put(opt_state, jm.Space.Device)
-        updates, new_opt_state = tx.update(grads, opt_state, state.params)
+        fused = getattr(tx, "fused_apply", None)
+        if fused is not None:
+            # Fused-optimizer fast path (ops/fused_adamw.py): params and
+            # state come back from one kernel pass — no separate
+            # apply_updates traversal.
+            new_params, new_opt_state = fused(grads, opt_state, state.params)
+        else:
+            updates, new_opt_state = tx.update(grads, opt_state, state.params)
+            new_params = optax.apply_updates(state.params, updates)
         if offload_opt_state:
             new_opt_state = jax.device_put(new_opt_state, jm.Space.Host)
-        new_params = optax.apply_updates(state.params, updates)
         out_metrics = dict(metrics)
         out_metrics["loss"] = loss.astype(jnp.float32)
         out_metrics["grad_norm"] = optax.global_norm(grads).astype(jnp.float32)

@@ -108,3 +108,114 @@ def test_offload_opt_state_refuses_backend_without_pinned_host(tmp_path):
     )
     with pytest.raises(ValueError, match="pinned_host"):
         Trainer(cfg)
+
+
+def test_fused_adamw_matches_optax_adamw():
+    """The fused kernel's math must be bit-compatible with optax.adamw
+    (same bias correction, decoupled decay, LR scaling) over several
+    steps — on the non-TPU fallback path AND through the Pallas kernel in
+    interpret mode (padding/unpadding included via odd-sized leaves)."""
+    import jax.numpy as jnp
+    import optax
+
+    from frl_distributed_ml_scaffold_tpu.ops.fused_adamw import fused_adamw
+
+    params = {
+        "w": jax.random.normal(jax.random.key(0), (37, 5)),  # odd size
+        "b": jax.random.normal(jax.random.key(1), (3,)),
+    }
+    sched = optax.cosine_decay_schedule(1e-2, 20)
+    kw = dict(b1=0.9, b2=0.95, eps=1e-8, weight_decay=0.1)
+    ref_tx = optax.adamw(sched, **kw)
+
+    for interpret in (None, True):  # None -> jnp fallback on CPU; True -> kernel
+        tx = fused_adamw(sched, interpret=interpret, **kw)
+        p_ref, s_ref = dict(params), ref_tx.init(params)
+        p_f, s_f = dict(params), tx.init(params)
+        for step in range(3):
+            grads = jax.tree.map(
+                lambda p: jnp.cos(p + step).astype(p.dtype), p_ref
+            )
+            u, s_ref = ref_tx.update(grads, s_ref, p_ref)
+            p_ref = optax.apply_updates(p_ref, u)
+            p_f, s_f = tx.fused_apply(grads, s_f, p_f)
+            jax.tree.map(
+                lambda a, b: np.testing.assert_allclose(
+                    a, b, atol=2e-6, rtol=2e-6
+                ),
+                p_ref,
+                p_f,
+            )
+        # The generic optax contract (deltas) agrees with fused_apply too.
+        tx2 = fused_adamw(sched, interpret=interpret, **kw)
+        p2, s2 = dict(params), tx2.init(params)
+        for step in range(2):
+            grads = jax.tree.map(
+                lambda p: jnp.cos(p + step).astype(p.dtype), p2
+            )
+            u2, s2 = tx2.update(grads, s2, p2)
+            p2 = optax.apply_updates(p2, u2)
+        # p2 after 2 steps == p_ref after... re-run ref for 2 steps
+        p_r, s_r = dict(params), ref_tx.init(params)
+        for step in range(2):
+            grads = jax.tree.map(
+                lambda p: jnp.cos(p + step).astype(p.dtype), p_r
+            )
+            u, s_r = ref_tx.update(grads, s_r, p_r)
+            p_r = optax.apply_updates(p_r, u)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, atol=2e-6, rtol=2e-6),
+            p_r,
+            p2,
+        )
+
+
+def test_fused_adamw_trains_end_to_end(tmp_path):
+    """optimizer.name=fused_adamw through the full trainer (fallback path
+    on the CPU sim): loss decreases, moment state is param-shaped."""
+    cfg = apply_overrides(
+        get_config("mnist_mlp"),
+        [
+            "optimizer.name=fused_adamw",
+            "optimizer.learning_rate=0.003",
+            "trainer.total_steps=12",
+            "trainer.log_every=1000",
+            "trainer.eval_every=0",
+            "data.global_batch_size=64",
+            "model.hidden_sizes=32",
+            "checkpoint.enabled=false",
+            f"workdir={tmp_path}",
+        ],
+    )
+    trainer = Trainer(cfg)
+    state = trainer.init_state()
+    losses = []
+    for step in range(8):
+        batch = trainer.pipeline.global_batch(step)
+        state, metrics = trainer.train_step(state, batch)
+        losses.append(float(jax.device_get(metrics["loss"])))
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+    assert int(jax.device_get(state.opt_state.count)) == 8
+
+
+def test_fused_adamw_refuses_grad_clip():
+    import pytest
+
+    with pytest.raises(ValueError, match="grad_clip_norm"):
+        make_optimizer(
+            OptimizerConfig(name="fused_adamw", grad_clip_norm=1.0),
+            TrainerConfig(total_steps=10),
+        )
+
+
+def test_fused_adamw_refuses_sharded_state(tmp_path):
+    """GSPMD cannot partition the opaque kernel: ZeRO/FSDP configs must be
+    refused, not silently all-gathered every step."""
+    import pytest
+
+    cfg = apply_overrides(
+        get_config("gpt2_medium_zero1"),
+        ["optimizer.name=fused_adamw", f"workdir={tmp_path}"],
+    )
+    with pytest.raises(ValueError, match="fused_adamw requires replicated"):
+        Trainer(cfg)

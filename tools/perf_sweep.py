@@ -267,10 +267,25 @@ def gpt2_offload():
                 )
 
 
+def rn50_fused_opt():
+    """BACKLOG-5 experiment: the RN50 optimizer+casts segment is ~7 ms/step
+    of pure bandwidth; compare the recipe default (sgd), optax adamw, and
+    the single-Pallas-pass fused_adamw (ops/fused_adamw.py). Ship
+    fused_adamw as a recommendation only if this measures a win."""
+    for opt in ("sgd", "adamw", "fused_adamw"):
+        t, s, b = build(
+            "imagenet_rn50_ddp",
+            ["data.global_batch_size=512", "model.stem=s2d",
+             f"optimizer.name={opt}"],
+        )
+        dt, _ = timed_steps(t, s, b, n=30, warm=4)
+        emit("rn50_fused_opt", 512, dt, {"optimizer": opt})
+
+
 GROUPS = {f.__name__: f for f in (rn50_bs, rn50_precision, rn50_fwd_only,
                                   rn50_depth, rn50_stem, rn50_split, vitb,
                                   rn50_headline, rn50_pool, gpt2_opt,
-                                  gpt2_offload)}
+                                  gpt2_offload, rn50_fused_opt)}
 
 if __name__ == "__main__":
     which = sys.argv[1:] or list(GROUPS)
