@@ -124,6 +124,26 @@ def main() -> int:
     failures += not ok
     emit("mnist_learns_on_chip", ok, losses=[round(l, 4) for l in losses])
 
+    # --- fused AdamW Pallas kernel, REAL Mosaic compile, vs optax -------
+    import optax
+
+    from frl_distributed_ml_scaffold_tpu.ops.fused_adamw import fused_adamw
+
+    t0 = time.time()
+    params = {"w": jax.random.normal(jax.random.key(3), (1024, 128))}
+    grads = jax.tree.map(lambda p: jnp.cos(p), params)
+    kw = dict(b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.01)
+    tx_f = fused_adamw(1e-3, **kw)
+    tx_r = optax.adamw(1e-3, **kw)
+    p_f, s_f = jax.jit(tx_f.fused_apply)(grads, tx_f.init(params), params)
+    u_r, _ = tx_r.update(grads, tx_r.init(params), params)
+    p_r = optax.apply_updates(params, u_r)
+    err = float(jnp.max(jnp.abs(p_f["w"] - p_r["w"])))
+    ok = err < 1e-5 and int(jax.device_get(s_f.count)) == 1
+    failures += not ok
+    emit("fused_adamw_kernel", ok, max_abs_err=err,
+         seconds=round(time.time() - t0, 1))
+
     # --- optimizer-state host offload (pinned_host is TPU-only) ----------
     cfg = apply_overrides(
         get_config("mnist_mlp"),
