@@ -72,6 +72,8 @@ def pipeline_summary(model_cfg) -> str | None:
     v = circular_repeat(model_cfg)
     bubble = (stages - 1) / (v * micro + stages - 1)
     sched = "gpipe" if v == 1 else f"circular(x{v})"
+    if getattr(model_cfg, "pipeline_stage_remat", False):
+        sched += "+stage-remat"
     return (
         f"pipeline: {stages} stages x {micro} microbatches [{sched}], "
         f"bubble fraction (S-1)/(vM+S-1) = {bubble:.3f}"

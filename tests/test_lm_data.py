@@ -2,6 +2,7 @@
 deterministic step-indexed sampling, synthetic fallback, trainer wiring."""
 
 import json
+import os
 
 import numpy as np
 import pytest
@@ -119,3 +120,40 @@ def test_gpt_trains_on_token_bin_corpus(tmp_path):
         state, metrics = trainer.train_step(state, batch)
         losses.append(float(metrics["loss"]))
     assert np.isfinite(losses).all()
+
+
+def test_encode_corpus_byte_level_round_trip(tmp_path):
+    """tools/encode_corpus.py --byte-level: raw text -> train.bin the LM
+    loader consumes — the producer CLI half of the token-bin contract."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    (tmp_path / "a.txt").write_text("hello world")
+    (tmp_path / "b.txt").write_text("second doc")
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "encode_corpus.py"),
+         str(tmp_path / "corpus"), str(tmp_path / "a.txt"),
+         str(tmp_path / "b.txt"), "--byte-level"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=repo,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    meta = json.loads(out.stdout.strip().splitlines()[-1])
+    # 11 + separator + 10 + separator
+    assert meta["tokens"] == 23 and meta["vocab_size"] == 256
+
+    cfg = DataConfig(
+        name="lm", data_dir=str(tmp_path / "corpus"), seq_len=8,
+        vocab_size=256, global_batch_size=4,
+    )
+    ds = TokenBinLM(cfg, split="train")
+    assert not ds.is_synthetic
+    batch = ds.batch(0, batch_size=4)
+    x = batch["tokens"]
+    assert x.shape == (4, 9) and x.dtype == np.int32  # seq_len + 1
+    # Byte-level: every sampled window is a verbatim slice of the corpus
+    # byte stream (documents joined by the 0 separator).
+    corpus = b"hello world\x00second doc\x00"
+    for row in x:
+        assert bytes(row.astype(np.uint8)) in corpus, row

@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Raw text → token-bin corpus producer for the LM loader (SURVEY C16).
+
+The LM loader reads nanoGPT-style flat token binaries
+(``{split}.bin`` + sidecar — data/lm.py ``write_token_bin``); this is the
+CLI that materializes them from text:
+
+    # Hugging Face tokenizer from a LOCAL checkpoint/tokenizer dir (this
+    # image has no network; any dir transformers can load offline works):
+    python tools/encode_corpus.py <out_dir> a.txt b.txt \
+        --tokenizer /path/to/gpt2_dir --split train
+
+    # Zero-dependency byte-level fallback (vocab 256 = raw UTF-8 bytes —
+    # the classic char/byte-LM setup; pairs with model.vocab_size=256):
+    python tools/encode_corpus.py <out_dir> corpus.txt --byte-level
+
+Files are concatenated in argument order with ``--eot-id`` (tokenizer's
+eos by default; 0 for byte-level) between documents, the convention LM
+samplers rely on to avoid cross-document attention windows carrying
+meaning. Emits one JSON summary line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def encode_files(paths, args) -> tuple[np.ndarray, int]:
+    """Returns (token stream, vocab_size)."""
+    if args.byte_level:
+        eot = 0 if args.eot_id is None else args.eot_id
+        chunks = []
+        for p in paths:
+            with open(p, "rb") as fh:
+                chunks.append(np.frombuffer(fh.read(), np.uint8).astype(np.uint16))
+            chunks.append(np.array([eot], np.uint16))
+        return np.concatenate(chunks), 256
+
+    from transformers import AutoTokenizer  # host tooling only
+
+    tok = AutoTokenizer.from_pretrained(args.tokenizer)
+    eot = tok.eos_token_id if args.eot_id is None else args.eot_id
+    if eot is None:
+        raise SystemExit(
+            "tokenizer has no eos token; pass --eot-id explicitly"
+        )
+    chunks = []
+    for p in paths:
+        with open(p, encoding="utf-8") as fh:
+            # No automatic special tokens: tokenizers that inject BOS/CLS/
+            # SEP per encode would double up on the explicit eot separator
+            # and scatter spurious marker ids through the stream.
+            ids = tok.encode(fh.read(), add_special_tokens=False)
+        chunks.append(np.asarray(ids, np.int64))
+        chunks.append(np.array([eot], np.int64))
+    return np.concatenate(chunks), int(len(tok))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("out_dir")
+    ap.add_argument("files", nargs="+")
+    ap.add_argument("--split", default="train")
+    ap.add_argument("--tokenizer", default=None,
+                    help="local HF tokenizer dir/name (offline)")
+    ap.add_argument("--byte-level", action="store_true",
+                    help="raw UTF-8 bytes, vocab 256 (no tokenizer needed)")
+    ap.add_argument("--eot-id", type=int, default=None,
+                    help="document separator id (default: tokenizer eos; 0 for bytes)")
+    args = ap.parse_args()
+    if not args.byte_level and args.tokenizer is None:
+        ap.error("pass --tokenizer <local dir> or --byte-level")
+
+    from frl_distributed_ml_scaffold_tpu.data.lm import write_token_bin
+
+    tokens, vocab = encode_files(args.files, args)
+    path = os.path.join(args.out_dir, f"{args.split}.bin")
+    write_token_bin(path, tokens, vocab_size=vocab)
+    print(json.dumps({
+        "split": args.split, "tokens": int(tokens.size),
+        "vocab_size": vocab, "files": len(args.files), "path": path,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
