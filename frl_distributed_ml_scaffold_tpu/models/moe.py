@@ -40,15 +40,28 @@ from frl_distributed_ml_scaffold_tpu.dist.mesh import (
 )
 
 
-def _num_groups(moe, n: int) -> int:
-    """Routing-group count for ``n`` tokens. Explicit config is honored
-    when it divides ``n``; otherwise (and for auto) ``gcd`` snaps to the
-    nearest divisor — the same module must route full training batches
-    AND the tiny token counts of KV-cache decode steps (n = batch at one
-    token per sequence), where a hard divisibility error would make every
-    grouped-MoE checkpoint un-generatable. Auto (0) follows the mesh's
-    batch sharding so each data shard routes its own tokens."""
+def _num_groups(moe, n: int, b: int, train: bool) -> int:
+    """Routing-group count for ``n`` tokens (batch dim ``b``).
+
+    Explicit config must divide the token count in the TRAINING path —
+    a silent gcd snap there would change per-group capacity semantics
+    (different drop boundaries) with no signal, so it raises instead. In
+    the decode path (train=False, tiny n = batch at one token per
+    sequence) ``gcd`` snaps to the nearest divisor: a hard divisibility
+    error would make every grouped-MoE checkpoint un-generatable.
+
+    Auto (0) follows the mesh's batch sharding so each data shard routes
+    its own tokens — snapped to ``gcd(b, shards)`` so the group dim always
+    aligns with the batch dim (never cuts a group mid-sequence) and stays
+    batch-sharded through every einsum; since g | b and n = b*t, g | n."""
     if moe.num_groups > 0:
+        if train and n % moe.num_groups != 0:
+            raise ValueError(
+                f"moe.num_groups={moe.num_groups} does not divide the "
+                f"training token count n={n} (batch {b}); a silent snap "
+                "would change per-group capacity/drop semantics. Pick a "
+                "divisor of batch*seq or use num_groups=0 (auto)."
+            )
         return math.gcd(n, moe.num_groups)
     env = current_mesh_env()
     if env is None:
@@ -56,7 +69,7 @@ def _num_groups(moe, n: int) -> int:
     shards = 1
     for a in BATCH_AXES:
         shards *= env.mesh.shape.get(a, 1)
-    return math.gcd(n, shards)
+    return math.gcd(b, shards)
 
 
 class MoEMlp(nn.Module):
@@ -72,7 +85,7 @@ class MoEMlp(nn.Module):
         e, k = moe.num_experts, moe.top_k
         b, t, _ = x.shape
         n = b * t
-        g = _num_groups(moe, n)
+        g = _num_groups(moe, n, b, train)
         s = n // g
         capacity = max(1, int(moe.capacity_factor * s * k / e))
         # Cast to the compute dtype here (the dense MLP gets this implicitly

@@ -544,3 +544,50 @@ def test_moe_router_z_loss_penalizes_large_logits():
     m0 = MoEMlp(cfg0, jnp.float32)
     _, aux0 = jax.jit(lambda v, xx: m0.apply(v, xx, train=False))(variables, x)
     assert float(aux) > float(aux0)  # the z term is there and positive
+
+
+def test_moe_explicit_groups_must_divide_in_training():
+    """A silent gcd snap of an explicit num_groups in the TRAINING path
+    would change per-group capacity/drop semantics with no signal
+    (round-3 advisor finding): num_groups=6 with n=32 must raise, not
+    quietly train with G=2. The decode path keeps the gcd fallback
+    (covered in test_generation's grouped-MoE decode case)."""
+    import pytest
+
+    from frl_distributed_ml_scaffold_tpu.models.moe import MoEMlp
+
+    cfg = tiny_gpt(
+        moe=MoEConfig(num_experts=4, top_k=2, num_groups=6)
+    )
+    m = MoEMlp(cfg, jnp.float32)
+    x = jax.random.normal(jax.random.key(0), (2, 16, 32), jnp.float32)
+    with pytest.raises(ValueError, match="num_groups=6 does not divide"):
+        m.init(jax.random.key(1), x, train=True)
+    # train=False (decode) still snaps: init succeeds.
+    variables = m.init(jax.random.key(1), x, train=False)
+    y, _ = m.apply(variables, x, train=False)
+    assert y.shape == x.shape
+
+
+def test_moe_auto_groups_align_with_batch_dim():
+    """Auto group count must divide the BATCH dim (not merely n=b*t) so
+    the (b,t,d)->(g,s,d) reshape never cuts a group mid-sequence and the
+    group dim stays batch-sharded (round-3 advisor finding)."""
+    from frl_distributed_ml_scaffold_tpu.models.moe import _num_groups
+
+    moe = MoEConfig(num_experts=4, top_k=2, num_groups=0)
+    # No mesh env in this test process scope -> auto is 1.
+    assert _num_groups(moe, 32, 2, True) == 1
+
+    from frl_distributed_ml_scaffold_tpu.config.schema import MeshConfig
+    from frl_distributed_ml_scaffold_tpu.dist.mesh import (
+        build_mesh,
+        mesh_context,
+    )
+
+    env = build_mesh(MeshConfig(data=8))
+    with mesh_context(env):
+        # b=2, 8 batch shards: shards does not divide b -> snap to
+        # gcd(2, 8) = 2, never 8 (which divides n=32 but cuts sequences).
+        assert _num_groups(moe, 32, 2, True) == 2
+        assert _num_groups(moe, 64, 8, True) == 8

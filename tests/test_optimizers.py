@@ -219,3 +219,19 @@ def test_fused_adamw_refuses_sharded_state(tmp_path):
     )
     with pytest.raises(ValueError, match="fused_adamw requires replicated"):
         Trainer(cfg)
+
+
+def test_fused_adamw_refuses_tp_mesh(tmp_path):
+    """mesh.model>1 shards params via partition rules even under
+    param_sharding=replicated — the opaque kernel would silently
+    all-gather them each step (round-3 advisor finding), so the trainer
+    must refuse TP/EP meshes just like ZeRO/FSDP."""
+    import pytest
+
+    cfg = apply_overrides(
+        get_config("gpt2_tp"),
+        ["optimizer.name=fused_adamw", f"workdir={tmp_path}"],
+    )
+    assert cfg.mesh.model > 1 and cfg.parallel.param_sharding == "replicated"
+    with pytest.raises(ValueError, match="fused_adamw requires replicated"):
+        Trainer(cfg)

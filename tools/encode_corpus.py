@@ -36,6 +36,13 @@ def encode_files(paths, args) -> tuple[np.ndarray, int]:
     """Returns (token stream, vocab_size)."""
     if args.byte_level:
         eot = 0 if args.eot_id is None else args.eot_id
+        if not 0 <= eot < 65536:
+            # uint16 storage would silently wrap an out-of-range id and
+            # corrupt the stream with no error.
+            raise SystemExit(
+                f"--eot-id {eot} out of uint16 range [0, 65536) for "
+                "byte-level encoding"
+            )
         chunks = []
         for p in paths:
             with open(p, "rb") as fh:
@@ -50,6 +57,10 @@ def encode_files(paths, args) -> tuple[np.ndarray, int]:
     if eot is None:
         raise SystemExit(
             "tokenizer has no eos token; pass --eot-id explicitly"
+        )
+    if not 0 <= eot < len(tok):
+        raise SystemExit(
+            f"--eot-id {eot} out of tokenizer vocab range [0, {len(tok)})"
         )
     chunks = []
     for p in paths:

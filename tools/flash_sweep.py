@@ -76,11 +76,14 @@ def sweep(args) -> int:
             rec = {"t": t, "block_q": bq, "block_k": bk}
             try:
                 loss, grads = fwd_bwd(q, k, v)  # compile + settle
-                jax.device_get(loss)
+                # Settle on the grads too: device_get of the scalar loss
+                # alone can return while the backward of the last iter is
+                # still executing (collective_bench settle-ordering class).
+                jax.device_get(jax.tree.map(lambda a: a.ravel()[0], grads))
                 t0 = time.perf_counter()
                 for _ in range(args.iters):
                     loss, grads = fwd_bwd(q, k, v)
-                jax.device_get(loss)
+                jax.device_get(jax.tree.map(lambda a: a.ravel()[0], grads))
                 dt = (time.perf_counter() - t0) / args.iters
                 rec.update(
                     fwd_bwd_ms=round(dt * 1e3, 2),
