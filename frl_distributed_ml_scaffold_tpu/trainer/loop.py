@@ -102,19 +102,21 @@ class Trainer:
             or cfg.parallel.param_sharding != "replicated"
             or cfg.mesh.model > 1
             or cfg.mesh.expert > 1
+            or cfg.mesh.pipe > 1
         ):
             # The fused kernel is opaque to GSPMD: sharded mu/nu/params
             # would be silently all-gathered every step, defeating the
             # exact memory savings ZeRO/FSDP exist for (ops/fused_adamw.py
             # honesty contract) — refuse rather than de-optimize quietly.
-            # mesh.model/expert > 1 shard params via partition rules even
-            # under param_sharding=replicated, so those meshes are refused
-            # on the same grounds as ZeRO/FSDP.
+            # mesh.model/expert/pipe > 1 shard params via partition rules
+            # even under param_sharding=replicated (TP column/row splits,
+            # expert stacks, pipeline stage dims), so those meshes are
+            # refused on the same grounds as ZeRO/FSDP.
             raise ValueError(
                 "optimizer.name=fused_adamw requires replicated state "
                 "(parallel.param_sharding=replicated, "
-                "opt_sharding=like_params) on a mesh with model=1 and "
-                "expert=1; use adamw for sharded-state configs"
+                "opt_sharding=like_params) on a mesh with model=1, "
+                "expert=1 and pipe=1; use adamw for sharded-state configs"
             )
         self.env = mesh_env if mesh_env is not None else build_mesh(cfg.mesh)
         self.policy = get_policy(cfg.precision)

@@ -563,6 +563,11 @@ def test_moe_explicit_groups_must_divide_in_training():
     x = jax.random.normal(jax.random.key(0), (2, 16, 32), jnp.float32)
     with pytest.raises(ValueError, match="num_groups=6 does not divide"):
         m.init(jax.random.key(1), x, train=True)
+    # num_groups=16 divides n=32 but not b=2: groups would cut sequences
+    # and break batch alignment — refused on the same grounds.
+    cfg16 = tiny_gpt(moe=MoEConfig(num_experts=4, top_k=2, num_groups=16))
+    with pytest.raises(ValueError, match="num_groups=16 does not divide"):
+        MoEMlp(cfg16, jnp.float32).init(jax.random.key(1), x, train=True)
     # train=False (decode) still snaps: init succeeds.
     variables = m.init(jax.random.key(1), x, train=False)
     y, _ = m.apply(variables, x, train=False)

@@ -36,12 +36,13 @@ def encode_files(paths, args) -> tuple[np.ndarray, int]:
     """Returns (token stream, vocab_size)."""
     if args.byte_level:
         eot = 0 if args.eot_id is None else args.eot_id
-        if not 0 <= eot < 65536:
-            # uint16 storage would silently wrap an out-of-range id and
-            # corrupt the stream with no error.
+        if not 0 <= eot < 256:
+            # Out-of-range ids would either wrap in the uint16 separator
+            # array or fail late in write_token_bin after all files are
+            # read; fail fast against the byte vocab, mirroring the
+            # tokenizer path's check below.
             raise SystemExit(
-                f"--eot-id {eot} out of uint16 range [0, 65536) for "
-                "byte-level encoding"
+                f"--eot-id {eot} out of byte-level vocab range [0, 256)"
             )
         chunks = []
         for p in paths:
