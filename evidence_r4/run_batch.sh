@@ -1,0 +1,32 @@
+#!/bin/bash
+# Round-4 evidence batch (VERDICT r3 next-round #1): the relay answered at
+# 21:06 UTC 2026-07-30 — capture every staged on-chip measurement in order,
+# each stage bounded so a relay drop mid-batch cannot hang the round.
+cd /root/repo
+set -o pipefail  # rc must be the python/timeout status, not tee's
+mkdir -p evidence_r4
+stamp() { date -u +"%Y-%m-%dT%H:%M:%SZ"; }
+
+echo "=== evidence batch start $(stamp) ==="
+
+echo "--- stage 1: tpu_smoke (incl. fused-AdamW first REAL Mosaic compile) ---"
+timeout 1500 python tools/tpu_smoke.py 2>&1 | tee evidence_r4/smoke.log
+echo "stage1 rc=$? $(stamp)"
+
+echo "--- stage 2: bench.py headline (reproduce 2257.9 / 0.903) ---"
+timeout 1500 python bench.py 2>&1 | tee evidence_r4/headline.log
+echo "stage2 rc=$? $(stamp)"
+
+echo "--- stage 3: bench.py --all (regenerate BENCH_TABLE.jsonl + gpt2_moe line) ---"
+timeout 3600 python bench.py --all 2>&1 | tee evidence_r4/bench_all.log
+echo "stage3 rc=$? $(stamp)"
+
+echo "--- stage 4: perf_sweep gpt2_opt gpt2_offload rn50_fused_opt ---"
+timeout 5400 python tools/perf_sweep.py gpt2_opt gpt2_offload rn50_fused_opt 2>&1 | tee evidence_r4/perf_sweep.log
+echo "stage4 rc=$? $(stamp)"
+
+echo "--- stage 5: flash_sweep ladder to 64k ---"
+timeout 5400 python tools/flash_sweep.py 2>&1 | tee evidence_r4/flash_sweep.log
+echo "stage5 rc=$? $(stamp)"
+
+echo "=== evidence batch done $(stamp) ==="

@@ -301,6 +301,25 @@ class GPTConfig:
     # trainer.remat=full (which recomputes the whole pipeline timeline
     # inside the backward); composes with either schedule above.
     pipeline_stage_remat: bool = False
+    # Per-block (per-layer) rematerialization on the nn.scan stack — the
+    # selective policy tier between trainer.remat=dots (saves every matmul
+    # output: O(L·B·T·D·(9+mlp_ratio)) residuals) and trainer.remat=full
+    # (whole-loss checkpoint: low forward residency but the backward's
+    # recompute materializes the full scan residual set at once). Each
+    # scanned Block is checkpointed individually, so the backward holds the
+    # L carry boundaries [B,T,D] plus ONE block's internals at a time:
+    #   "full"      — save only the scan carry per layer (max memory cut,
+    #                 one extra block-forward per layer of recompute);
+    #   "save_attn" — additionally save each block's attention-sublayer
+    #                 output ([B,T,D]/layer, checkpoint_name-tagged), so
+    #                 the recompute pass skips re-running attention — the
+    #                 quadratic part of the block — for ~2x the (tiny)
+    #                 boundary residuals;
+    #   "none"      — off.
+    # Residual accounting across these modes: tools/pp_memory_audit.py
+    # --flagship. Ignored by the pipeline path (pipeline_stage_remat is
+    # that path's equivalent) and by decode.
+    block_remat: str = "none"
 
 
 @dataclass(frozen=True)

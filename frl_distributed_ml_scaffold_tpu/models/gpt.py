@@ -26,6 +26,7 @@ from typing import Any
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec as P
 
 from frl_distributed_ml_scaffold_tpu.config.schema import GPTConfig
@@ -232,9 +233,14 @@ class Block(nn.Module):
         x, aux_loss = carry
         cfg, train = self.config, self.train
         y = nn.LayerNorm(dtype=jnp.float32, epsilon=cfg.layer_norm_epsilon, name="ln1")(x)
-        x = x + CausalSelfAttention(cfg, self.dtype, name="attn")(
+        attn_out = CausalSelfAttention(cfg, self.dtype, name="attn")(
             y, train=train, decode=self.decode
         )
+        # Named for block_remat="save_attn": saving this one [B,T,D] tensor
+        # per layer lets the per-block recompute skip the attention sublayer
+        # (the quadratic part). A no-op unless a checkpoint policy asks.
+        attn_out = checkpoint_name(attn_out, "attn_out")
+        x = x + attn_out
         y = nn.LayerNorm(dtype=jnp.float32, epsilon=cfg.layer_norm_epsilon, name="ln2")(x)
         if cfg.moe.num_experts > 0:
             from frl_distributed_ml_scaffold_tpu.models.moe import MoEMlp
@@ -323,8 +329,30 @@ class GPT(nn.Module):
             )
             x, aux_loss = pipe(x, jnp.zeros((), jnp.float32))
         else:
+            block_cls = Block
+            if cfg.block_remat != "none" and not decode:
+                # Per-layer remat (config 3's activation checkpointing at
+                # the granularity that matters under nn.scan): checkpoint
+                # each scanned body so the backward re-derives one block's
+                # internals at a time instead of holding all L layers'.
+                # prevent_cse=False is the documented setting under scan —
+                # the scan boundary already stops the CSE that remat's
+                # default guards against, and leaving it True blocks XLA
+                # optimizations for nothing.
+                if cfg.block_remat == "full":
+                    policy = None
+                elif cfg.block_remat == "save_attn":
+                    policy = jax.checkpoint_policies.save_only_these_names(
+                        "attn_out"
+                    )
+                else:
+                    raise KeyError(
+                        f"unknown model.block_remat={cfg.block_remat!r} "
+                        "(none | full | save_attn)"
+                    )
+                block_cls = nn.remat(Block, prevent_cse=False, policy=policy)
             blocks = nn.scan(
-                Block,
+                block_cls,
                 length=cfg.num_layers,
                 variable_axes={"params": 0, "cache": 0},
                 split_rngs={"params": True, "dropout": True},

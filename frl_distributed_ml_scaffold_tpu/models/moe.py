@@ -55,10 +55,11 @@ def _num_groups(moe, n: int, b: int, train: bool) -> int:
     aligns with the batch dim (never cuts a group mid-sequence) and stays
     batch-sharded through every einsum; since g | b and n = b*t, g | n."""
     if moe.num_groups > 0:
-        if train and (n % moe.num_groups != 0 or b % moe.num_groups != 0):
+        if train and b % moe.num_groups != 0:
             # Divide the BATCH dim, not merely n=b*t: a group that cuts a
             # sequence breaks the batch alignment the einsum sharding
-            # relies on (same invariant as the auto path below).
+            # relies on (same invariant as the auto path below); g | b
+            # also gives g | n since n = b*t.
             raise ValueError(
                 f"moe.num_groups={moe.num_groups} does not divide the "
                 f"training batch dim b={b} (token count n={n}); a silent "
@@ -67,7 +68,8 @@ def _num_groups(moe, n: int, b: int, train: bool) -> int:
                 "batch-sharded. Pick a divisor of the batch size or use "
                 "num_groups=0 (auto)."
             )
-        return math.gcd(n, moe.num_groups)
+        # The gcd snap only serves decode (train=False, tiny n).
+        return moe.num_groups if train else math.gcd(n, moe.num_groups)
     env = current_mesh_env()
     if env is None:
         return 1

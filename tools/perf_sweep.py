@@ -234,6 +234,52 @@ def gpt2_opt():
                     )
 
 
+def gpt2_block_remat():
+    """The round-4 attack on the 33.7% MFU wall: per-block remat
+    (model.block_remat) caps backward residency at the L carry boundaries
+    plus one block's internals — the flagship audit (pp_memory_audit
+    --flagship) shows mb8 needs 6.8G (full) / 7.2G (save_attn) vs 24.5G
+    with remat=dots — so the microbatch can finally grow past 4. Sweep
+    the unlocked operating points against the mb4/dots protocol line."""
+    base = [
+        "model.attention=flash",
+        "model.lm_loss_chunk=128",
+        "trainer.grad_accum=1",
+        "trainer.remat=none",
+    ]
+    # Protocol baseline first so every run of this group is self-contained.
+    t, s, b = build(
+        "gpt2_medium_zero1",
+        ["model.attention=flash", "model.lm_loss_chunk=128",
+         "trainer.grad_accum=1", "data.global_batch_size=4",
+         "trainer.remat=dots"],
+    )
+    dt, _ = timed_steps(t, s, b, n=10, warm=3)
+    emit("gpt2_block_remat", 4, dt, {"remat": "dots", "block_remat": "none"})
+    for br in ("save_attn", "full"):
+        for mb in (8, 16, 32):
+            tag = {"remat": "none", "block_remat": br}
+            try:
+                t, s, b = build(
+                    "gpt2_medium_zero1",
+                    base + [
+                        f"model.block_remat={br}",
+                        f"data.global_batch_size={mb}",
+                    ],
+                )
+                dt, _ = timed_steps(t, s, b, n=10, warm=3)
+                emit("gpt2_block_remat", mb, dt, tag)
+            except Exception as e:
+                print(
+                    json.dumps(
+                        {"experiment": "gpt2_block_remat",
+                         "global_batch_size": mb, **tag,
+                         "error": str(e)[:160]}
+                    ),
+                    flush=True,
+                )
+
+
 def gpt2_offload():
     """Re-test opt-state host offload under bigger batches: the ~17x
     pinned_host streaming cost (docs/perf_playbook.md) amortizes
@@ -285,7 +331,8 @@ def rn50_fused_opt():
 GROUPS = {f.__name__: f for f in (rn50_bs, rn50_precision, rn50_fwd_only,
                                   rn50_depth, rn50_stem, rn50_split, vitb,
                                   rn50_headline, rn50_pool, gpt2_opt,
-                                  gpt2_offload, rn50_fused_opt)}
+                                  gpt2_block_remat, gpt2_offload,
+                                  rn50_fused_opt)}
 
 if __name__ == "__main__":
     which = sys.argv[1:] or list(GROUPS)

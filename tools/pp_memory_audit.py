@@ -21,6 +21,17 @@ after ``pipe`` sharding. ``--remat full`` shows jax.checkpoint collapsing
 top-level residuals to the inputs (peak then moves inside the recompute).
 Emits one JSON line per variant plus a table; docs/perf_playbook.md
 records the conclusions.
+
+``--flagship`` switches to the single-chip GPT-2-medium audit (VERDICT r3
+next-round #3): sweep (trainer.remat | model.block_remat) x microbatch at
+the REAL protocol shapes (L=24, D=1024, T=1024, flash attention, chunked
+LM loss) and report, per variant, the forward->backward residual bytes
+the backward must hold, next to the config's resident-state bytes (fp32
+master params + AdamW mu/nu + fp32 grads + bf16 compute copy), so
+"does microbatch 8 fit in 15.75G?" is answerable from residual
+accounting BEFORE burning relay time:
+
+    python tools/pp_memory_audit.py --flagship [--mb 4 8 16]
 """
 
 from __future__ import annotations
@@ -126,6 +137,104 @@ def audit_one(args, sched: str, overrides: list[str], remat: str) -> dict:
     return rec
 
 
+def flagship_one(mb: int, remat: str, block_remat: str) -> dict:
+    import jax
+    import jax.numpy as jnp
+    from jax._src.ad_checkpoint import saved_residuals
+
+    from frl_distributed_ml_scaffold_tpu.config import apply_overrides, get_config
+    from frl_distributed_ml_scaffold_tpu.trainer.loop import Trainer
+    from frl_distributed_ml_scaffold_tpu.trainer.tasks import example_input
+    from frl_distributed_ml_scaffold_tpu.trainer.train_step import _remat_wrap
+
+    cfg = apply_overrides(
+        get_config("gpt2_medium_zero1"),
+        [
+            # The BENCH_TABLE protocol operating point (bench.py
+            # ALL_CONFIGS), batch swept by the caller.
+            f"data.global_batch_size={mb}",
+            "trainer.grad_accum=1",
+            "model.attention=flash",
+            "model.lm_loss_chunk=128",
+            # Single-chip semantics on the 8-device CPU sim: every mesh
+            # axis 1, as on the real v5e chip the numbers are for.
+            "mesh.data=1", "mesh.fsdp=1", "mesh.model=1",
+            "mesh.pipe=1", "mesh.seq=1", "mesh.expert=1",
+            f"trainer.remat={remat}",
+            f"model.block_remat={block_remat}",
+            "checkpoint.enabled=false",
+            "data.prefetch=0",
+        ],
+    )
+    trainer = Trainer(cfg)
+    example = {
+        k: jnp.asarray(v)
+        for k, v in example_input(
+            cfg.data, cfg.model, batch_size=mb
+        ).items()
+    }
+    wrapped = _remat_wrap(trainer.loss_fn, remat)
+
+    def scalar_loss(params):
+        loss, _ = wrapped(
+            params, trainer.state_shapes.extras, example,
+            jax.random.key(0), True,
+        )
+        return loss
+
+    res = trainer._mesh_scoped(saved_residuals)(
+        scalar_loss, trainer.state_shapes.params
+    )
+    total, by_shape = _residual_bytes(res)
+    param_bytes = sum(
+        int(l.size) * l.dtype.itemsize
+        for l in jax.tree.leaves(trainer.state_shapes.params)
+    )
+    # Resident state for this config (ZeRO-1 on one chip = unsharded):
+    # fp32 master params, AdamW mu+nu (fp32, like_params), fp32 grads
+    # held across the update, plus the bf16 compute-cast copy alive
+    # through the backward.
+    resident = param_bytes * (1 + 2 + 1) + param_bytes // 2
+    act = total - param_bytes
+    rec = {
+        "mb": mb,
+        "remat": remat,
+        "block_remat": block_remat,
+        "residual_minus_params_mb": round(act / 1e6, 1),
+        "resident_state_mb": round(resident / 1e6, 1),
+        "total_mb": round((act + resident) / 1e6, 1),
+        "fits_15_75g": bool(act + resident < 15.75e9),
+    }
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+def flagship_main(args) -> int:
+    variants = [
+        ("dots", "none"),   # the round-3 protocol line (mb4 knee)
+        ("none", "none"),
+        ("full", "none"),
+        ("none", "full"),
+        ("none", "save_attn"),
+    ]
+    rows = []
+    for mb in args.mb:
+        for remat, br in variants:
+            rows.append(flagship_one(mb, remat, br))
+    print(
+        f"\n{'mb':>3s} {'remat':>6s} {'block_remat':>11s} "
+        f"{'activations MB':>15s} {'resident MB':>12s} {'total MB':>9s}  fits15.75G"
+    )
+    for r in rows:
+        print(
+            f"{r['mb']:3d} {r['remat']:>6s} {r['block_remat']:>11s} "
+            f"{r['residual_minus_params_mb']:15.1f} "
+            f"{r['resident_state_mb']:12.1f} {r['total_mb']:9.1f}  "
+            f"{'yes' if r['fits_15_75g'] else 'NO'}"
+        )
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--layers", type=int, default=8)
@@ -138,15 +247,24 @@ def main() -> int:
     ap.add_argument("--microbatches", type=int, default=8)
     ap.add_argument("--repeat", type=int, default=2)
     ap.add_argument("--remat", default="none", choices=["none", "dots", "full"])
+    ap.add_argument("--flagship", action="store_true",
+                    help="single-chip GPT-2-medium remat-mode sweep")
+    ap.add_argument("--mb", type=int, nargs="+", default=[4, 8, 16],
+                    help="--flagship microbatch sizes")
     args = ap.parse_args()
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
+        # Flagship mode audits the one-chip config — a single CPU device
+        # keeps the mesh honest; the PP audit needs the 8-device sim.
+        n = 1 if args.flagship else 8
         os.environ["XLA_FLAGS"] = (
-            flags + " --xla_force_host_platform_device_count=8"
+            flags + f" --xla_force_host_platform_device_count={n}"
         ).strip()
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    if args.flagship:
+        return flagship_main(args)
 
     gpipe_ov = [
         f"model.pipeline_stages={args.stages}",
