@@ -166,16 +166,20 @@ ALL_CONFIGS = [
     ("imagenet_vitb_fsdp",
      ["data.global_batch_size=256", "trainer.remat=none"], 20),
     (
-        # Microbatch 4: the largest that fits one v5e chip with the 355M
-        # param + AdamW fp32 state resident (microbatch 8 needs 22.65G of
-        # 15.75G HBM with remat=dots — measured 2026-07-30; remat=full at
-        # mb8 also fails AOT compile on the relay).
+        # Round-4 operating point: per-block remat (model.block_remat)
+        # caps backward residency at one block's internals, unlocking
+        # microbatch 8 — measured 33.6 samples/sec/chip vs 24.25 at the
+        # old mb4/remat=dots knee (+39%, MFU 0.337 → 0.467). See
+        # docs/perf_playbook.md "Per-block remat on the flagship" and
+        # tools/perf_sweep.py gpt2_block_remat (mb16/32 measure the same
+        # within noise; mb8 recompiles fastest).
         # lm_loss_chunk: chunked-vocab head+CE — skips the [B,T,50257]
         # logits materialization; measured +9% at microbatch 4 (19.78 vs
         # 18.15 samples/sec/chip) on top of the memory saved.
         "gpt2_medium_zero1",
-        ["data.global_batch_size=4", "trainer.grad_accum=1",
-         "model.attention=flash", "model.lm_loss_chunk=128"],
+        ["data.global_batch_size=8", "trainer.grad_accum=1",
+         "model.attention=flash", "model.lm_loss_chunk=128",
+         "trainer.remat=none", "model.block_remat=full"],
         10,
     ),
     (
@@ -183,10 +187,15 @@ ALL_CONFIGS = [
         # axis to shard (mesh.expert=1 — EP itself is sim-verified), but
         # the grouped GSEC dispatch, capacity routing, z-loss, and the
         # stacked-expert FFN einsums all run at real shapes here.
+        # 908M params: AdamW's fp32 mu/nu alone (10.9G) blow the 15.75G
+        # chip (first on-chip attempt 2026-07-30 died in relay compile),
+        # so the single-chip line runs Adafactor (factored second moment —
+        # the standard MoE-scale choice) + per-block remat.
         "gpt2_moe",
-        ["data.global_batch_size=4", "trainer.grad_accum=1",
+        ["data.global_batch_size=8", "trainer.grad_accum=1",
          "model.attention=flash", "model.lm_loss_chunk=128",
-         "mesh.expert=1"],
+         "mesh.expert=1", "optimizer.name=adafactor",
+         "trainer.remat=none", "model.block_remat=full"],
         10,
     ),
     ("ego4d_video_elastic", ["data.global_batch_size=32",

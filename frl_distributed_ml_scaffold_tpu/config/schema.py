@@ -172,6 +172,16 @@ class ElasticConfig:
     # the restart budget and backoff reset (torchrun-elastic-agent semantics),
     # so a week-long run isn't killed by its 4th once-a-day preemption.
     reset_after_s: float = 600.0
+    # Smaller-slice continuation (SURVEY C14 "re-initialize (possibly
+    # smaller slice)"): after this many consecutive failed restarts, the
+    # supervisor consults the shared-workdir membership heartbeats; peers
+    # stale for more than ``peer_timeout_s`` are declared dead, and the
+    # child is re-launched over the surviving hosts only (ranks remapped,
+    # coordinator re-elected to the lowest surviving host, Orbax restores
+    # with resharding). 0 = never shrink — a missing host blocks until the
+    # restart budget runs out, the round-2/3 behavior.
+    shrink_after: int = 0
+    peer_timeout_s: float = 60.0
 
 
 @dataclass(frozen=True)

@@ -40,11 +40,22 @@ def initialize_distributed(
     if process_id is None and "FRL_TPU_PROCESS_ID" in os.environ:
         process_id = int(os.environ["FRL_TPU_PROCESS_ID"])
 
+    if num_processes == 1:
+        # Explicit single-process topology (e.g. the elastic supervisor
+        # shrinking to the last survivor): nothing to initialize, even when
+        # a stale FRL_TPU_COORDINATOR is still in the environment.
+        return
     if num_processes is not None and num_processes > 1:
+        # Bounded rendezvous: when a peer host is gone for good, the default
+        # 300 s initialization timeout is what the elastic supervisor's
+        # shrink policy (launcher/elastic.py) waits on — let deployments
+        # (and the shrink tests) tighten it.
+        timeout_s = int(os.environ.get("FRL_TPU_INIT_TIMEOUT_S", "300"))
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id,
+            initialization_timeout=timeout_s,
         )
         _INITIALIZED = True
     elif coordinator_address is not None:

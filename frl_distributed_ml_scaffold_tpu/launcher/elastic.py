@@ -20,9 +20,12 @@ crash → restart → resume cycle.
 
 from __future__ import annotations
 
+import json
 import os
+import socket
 import subprocess
 import sys
+import threading
 import time
 from typing import Callable, Optional
 
@@ -43,13 +46,18 @@ _REPO_ROOT = os.path.dirname(
 # --------------------------------------------------------------------------
 
 
-def _child_command(args) -> list[str]:
+def _child_command(args, topo: Optional[dict] = None) -> list[str]:
     """Re-exec the launcher without --elastic, checkpointing forced on.
 
     The forced overrides come last so they beat anything the user passed:
     a supervised run without checkpoint+resume would restart from step 0
-    forever.
+    forever. ``topo`` (coordinator / num_processes / process_id) overrides
+    the CLI topology after a shrink.
     """
+    topo = topo or {}
+    coordinator = topo.get("coordinator", args.coordinator)
+    num_processes = topo.get("num_processes", args.num_processes)
+    process_id = topo.get("process_id", args.process_id)
     cmd = [
         sys.executable,
         "-m",
@@ -61,15 +69,131 @@ def _child_command(args) -> list[str]:
     ]
     if args.device == "cpu" and args.sim_devices:
         cmd += ["--sim-devices", str(args.sim_devices)]
-    if args.coordinator:
-        cmd += ["--coordinator", args.coordinator]
-    if args.num_processes is not None:
-        cmd += ["--num-processes", str(args.num_processes)]
-    if args.process_id is not None:
-        cmd += ["--process-id", str(args.process_id)]
+    if coordinator:
+        cmd += ["--coordinator", coordinator]
+    if num_processes is not None:
+        cmd += ["--num-processes", str(num_processes)]
+    if process_id is not None:
+        cmd += ["--process-id", str(process_id)]
     cmd += list(args.overrides)
     cmd += ["checkpoint.enabled=true", "checkpoint.resume=true"]
     return cmd
+
+
+class _Membership:
+    """Shared-workdir host membership for the shrink policy.
+
+    The run's workdir is already the cross-host shared medium (Orbax
+    checkpoints live there), so liveness rides the same channel: each
+    host's supervisor heartbeats ``members/host_<uid>.json`` ({uid,
+    endpoint, ts}) from a daemon thread; any supervisor can read the
+    directory and declare peers whose heartbeat is older than
+    ``peer_timeout_s`` dead. ``uid`` is the host's ORIGINAL process id —
+    stable across shrinks (ranks are remapped per-topology, uids never).
+    ``endpoint`` is the coordinator address this host would serve if it
+    became rank 0 after a shrink (pre-allocated port, published so
+    survivors re-elect deterministically: lowest surviving uid wins).
+    No consensus protocol: every survivor computes the same answer from
+    the same files, which is exactly the torchrun-agent re-rendezvous
+    contract expressed over a shared filesystem instead of a TCP store.
+    """
+
+    def __init__(self, run_dir: str, uid: int, endpoint: str):
+        self.dir = os.path.join(run_dir, "members")
+        self.uid = uid
+        self.endpoint = endpoint
+        self.path = os.path.join(self.dir, f"host_{uid}.json")
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def beat(self) -> None:
+        os.makedirs(self.dir, exist_ok=True)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(
+                {"uid": self.uid, "endpoint": self.endpoint, "ts": time.time()},
+                fh,
+            )
+        os.replace(tmp, self.path)  # atomic: readers never see a torn write
+
+    def start(self, interval_s: float) -> None:
+        self.beat()
+
+        def loop() -> None:
+            while not self._stop.wait(interval_s):
+                try:
+                    self.beat()
+                except OSError as e:
+                    # A transient shared-FS blip (NFS hiccup, ENOSPC) must
+                    # not kill the thread for good: a silently dead
+                    # heartbeat gets this healthy host shrunk OUT of the
+                    # world by its peers. Log and retry next interval.
+                    get_logger().warning(
+                        "elastic: heartbeat write failed (%s); retrying", e
+                    )
+
+        self._thread = threading.Thread(
+            target=loop, name="elastic-heartbeat", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def retire(self) -> None:
+        """Clean-exit path: withdraw from membership so peers don't wait
+        out the staleness window on a host that finished its work."""
+        self.stop()
+        try:
+            os.remove(self.path)
+        except OSError:
+            pass
+
+    def survivors(self, peer_timeout_s: float) -> list[dict]:
+        """Hosts with a fresh heartbeat, sorted by uid (self always
+        qualifies — the daemon thread is beating)."""
+        now = time.time()
+        out = []
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            names = []
+        for name in names:
+            if not (name.startswith("host_") and name.endswith(".json")):
+                continue
+            try:
+                with open(os.path.join(self.dir, name)) as fh:
+                    rec = json.load(fh)
+            except (OSError, ValueError):
+                continue  # torn/just-deleted file: treat as absent this poll
+            if now - rec.get("ts", 0) <= peer_timeout_s:
+                out.append(rec)
+        return sorted(out, key=lambda r: r["uid"])
+
+
+def _own_endpoint(args) -> str:
+    """The coordinator address this host would serve after taking rank 0.
+
+    Host reachable-address resolution: ``FRL_TPU_HOST_ADDRESS`` env (tests
+    and multi-NIC deployments), else the current coordinator's host when we
+    already are rank 0, else this host's name. The port is freshly bound
+    then released — standard pre-allocation racy-but-practical pattern.
+    """
+    host = os.environ.get("FRL_TPU_HOST_ADDRESS")
+    if host is None:
+        if args.process_id in (0, None) and args.coordinator:
+            host = args.coordinator.rsplit(":", 1)[0]
+        else:
+            host = socket.gethostname()
+    if args.process_id in (0, None) and args.coordinator:
+        # Already the coordinator: keep serving the address peers know.
+        return args.coordinator
+    with socket.socket() as s:
+        s.bind((host, 0))
+        port = s.getsockname()[1]
+    return f"{host}:{port}"
 
 
 def supervise(args, cfg: ExperimentConfig) -> int:
@@ -79,41 +203,124 @@ def supervise(args, cfg: ExperimentConfig) -> int:
     exponential backoff starting at ``cfg.elastic.backoff_s``. A clean child
     exit (rc 0) ends supervision; exhausting the budget returns the child's
     last rc.
+
+    Shrink policy (``elastic.shrink_after > 0``, SURVEY C14 / call stack
+    (d)): after that many consecutive failed restarts, read the membership
+    heartbeats; if peers are dead (stale beyond ``elastic.peer_timeout_s``)
+    and this host survives, re-launch the child over the surviving hosts —
+    ranks remapped by surviving uid order, coordinator re-elected to the
+    lowest surviving uid's published endpoint, restart budget refreshed for
+    the new topology. The child's fresh ``initialize`` + Orbax resharding
+    restore (checkpoint/manager.py) do the actual continuation; data
+    sharding re-splits because per-host slicing keys off the new
+    process_count. A host that comes back after a shrink fails its stale
+    rendezvous and must be re-admitted by operator action — same contract
+    as a torchrun agent that missed the re-rendezvous round.
     """
     logger = get_logger()
-    cmd = _child_command(args)
     env = os.environ.copy()
     env["PYTHONPATH"] = _REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
-    restarts = 0
-    logger.info("elastic: supervising %s", " ".join(cmd))
-    while True:
-        t0 = time.monotonic()
-        rc = subprocess.call(cmd, cwd=_REPO_ROOT, env=env)
-        elapsed = time.monotonic() - t0
-        if rc == 0:
-            logger.info("elastic: run completed after %d restart(s)", restarts)
-            return 0
-        if elapsed >= cfg.elastic.reset_after_s:
-            restarts = 0  # the child made real progress; fresh fault budget
-        if restarts >= cfg.elastic.max_restarts:
-            logger.error(
-                "elastic: child rc=%d; restart budget (%d) exhausted — giving up",
-                rc,
-                cfg.elastic.max_restarts,
+
+    world = args.num_processes if args.num_processes is not None else 1
+    uid = args.process_id
+    topo: dict = {}
+    membership: Optional[_Membership] = None
+    if cfg.elastic.shrink_after > 0 and world > 1:
+        if uid is None:
+            # JAX-autodetected process ids (Cloud TPU metadata) are not
+            # visible to the supervisor: every host would heartbeat the
+            # same members/host_0.json and a cluster-wide child failure
+            # would split-brain into N concurrent rank-0 worlds writing
+            # one checkpoint dir. Shrink needs an explicit --process-id.
+            logger.warning(
+                "elastic: shrink_after=%d requires an explicit "
+                "--process-id (autodetected ids are not visible to the "
+                "supervisor); shrink policy DISABLED for this run",
+                cfg.elastic.shrink_after,
             )
-            return rc
-        restarts += 1
-        delay = cfg.elastic.backoff_s * (2 ** (restarts - 1))
-        logger.warning(
-            "elastic: child died rc=%d after %.1fs; restart %d/%d in %.1fs "
-            "(resume from last checkpoint)",
-            rc,
-            elapsed,
-            restarts,
-            cfg.elastic.max_restarts,
-            delay,
-        )
-        time.sleep(delay)
+        else:
+            membership = _Membership(
+                os.path.join(cfg.workdir, cfg.name), uid, _own_endpoint(args)
+            )
+            membership.start(
+                interval_s=max(0.5, cfg.elastic.peer_timeout_s / 4)
+            )
+
+    restarts = 0
+    consecutive_failures = 0
+    try:
+        cmd = _child_command(args)
+        logger.info("elastic: supervising %s", " ".join(cmd))
+        while True:
+            t0 = time.monotonic()
+            rc = subprocess.call(cmd, cwd=_REPO_ROOT, env=env)
+            elapsed = time.monotonic() - t0
+            if rc == 0:
+                logger.info(
+                    "elastic: run completed after %d restart(s)", restarts
+                )
+                return 0
+            if elapsed >= cfg.elastic.reset_after_s:
+                restarts = 0  # the child made real progress; fresh budget
+                consecutive_failures = 0
+            consecutive_failures += 1
+
+            if (
+                membership is not None
+                and world > 1
+                and consecutive_failures >= cfg.elastic.shrink_after
+            ):
+                surv = membership.survivors(cfg.elastic.peer_timeout_s)
+                uids = [r["uid"] for r in surv]
+                if uid in uids and len(surv) < world:
+                    new_world = len(surv)
+                    new_rank = uids.index(uid)
+                    new_coord = surv[0]["endpoint"] if new_world > 1 else None
+                    logger.warning(
+                        "elastic: shrinking from %d to %d processes "
+                        "(dead peers stale > %.0fs); new rank=%d "
+                        "coordinator=%s — resuming from last checkpoint "
+                        "with resharding restore",
+                        world,
+                        new_world,
+                        cfg.elastic.peer_timeout_s,
+                        new_rank,
+                        new_coord,
+                    )
+                    world = new_world
+                    topo = {
+                        "num_processes": new_world,
+                        "process_id": new_rank,
+                        "coordinator": new_coord,
+                    }
+                    cmd = _child_command(args, topo)
+                    restarts = 0  # fresh budget for the new topology
+                    consecutive_failures = 0
+                    continue  # relaunch immediately — peers already waited
+
+            if restarts >= cfg.elastic.max_restarts:
+                logger.error(
+                    "elastic: child rc=%d; restart budget (%d) exhausted — "
+                    "giving up",
+                    rc,
+                    cfg.elastic.max_restarts,
+                )
+                return rc
+            restarts += 1
+            delay = cfg.elastic.backoff_s * (2 ** (restarts - 1))
+            logger.warning(
+                "elastic: child died rc=%d after %.1fs; restart %d/%d in "
+                "%.1fs (resume from last checkpoint)",
+                rc,
+                elapsed,
+                restarts,
+                cfg.elastic.max_restarts,
+                delay,
+            )
+            time.sleep(delay)
+    finally:
+        if membership is not None:
+            membership.retire()
 
 
 # --------------------------------------------------------------------------

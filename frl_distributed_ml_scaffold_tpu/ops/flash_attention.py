@@ -62,6 +62,12 @@ def _warn_fallback(msg: str) -> None:
         get_logger().warning(msg)
 
 
+#: T at and above which the auto block size steps up to 1024x1024
+#: (tools/flash_sweep.py on-chip ladder, 2026-07-30: +21%/+37%/+39% over
+#: 512x512 at T=16k/32k/64k).
+_LONG_T_BLOCKS = 16384
+
+
 def _pick_block(t: int, preferred: int) -> int | None:
     """Largest power-of-two block <= preferred that divides t.
 
@@ -354,11 +360,20 @@ def _dense_bwd_lse(q, k, v, o, lse, do, *, causal):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
+def _auto_block(t: int) -> int:
+    """Length-adaptive preferred block (the measured v5e optimum): 512 at
+    short T, 1024 from ``_LONG_T_BLOCKS`` up — shared by the public entry
+    AND the ring/Ulysses per-hop kernels, whose local T is exactly the
+    long-context regime the sweep measured."""
+    return 1024 if t >= _LONG_T_BLOCKS else 512
+
+
 def _block_tileable(q, k) -> tuple[int, int] | None:
     tq, tk, d = q.shape[2], k.shape[2], q.shape[3]
     if tq != tk or d % 32 != 0:
         return None
-    bq, bk = _pick_block(tq, min(512, tq)), _pick_block(tk, min(512, tk))
+    bq = _pick_block(tq, min(_auto_block(tq), tq))
+    bk = _pick_block(tk, min(_auto_block(tk), tk))
     return (bq, bk) if bq and bk else None
 
 
@@ -452,18 +467,23 @@ def flash_attention(
     v: jax.Array,
     *,
     causal: bool = True,
-    block_q: int = 512,
-    block_k: int = 512,
+    block_q: int | None = None,
+    block_k: int | None = None,
     interpret: bool | None = None,
 ) -> jax.Array:
     """(B, T, H, D) fused flash attention; drop-in for ``dense_attention``.
 
-    Default blocks are the measured v5e optimum at LM shapes
-    ([4,1024,16,64] sweeps, 2026-07-30): (512, 512) runs the fwd+bwd call
-    ~20% faster than the previous (256, 256) — larger blocks amortize the
-    VMEM revolving and keep the MXU fed — and (1024, 1024) measures equal
-    within noise, so the smaller VMEM footprint wins. ``_pick_block``
-    clamps both to the sequence length so shorter/odd shapes still tile.
+    Default blocks are the measured v5e optimum at LM shapes, and they are
+    length-adaptive (``None`` = auto). At T=1024 ([4,1024,16,64] sweeps,
+    2026-07-30): (512, 512) runs the fwd+bwd call ~20% faster than the
+    previous (256, 256) — larger blocks amortize the VMEM revolving and
+    keep the MXU fed — and (1024, 1024) measures equal within noise, so
+    the smaller VMEM footprint wins at short T. At long T the balance
+    flips: the on-chip ladder (tools/flash_sweep.py, 64k, 2026-07-30)
+    measures (1024, 1024) at +21%/+37%/+39% over (512, 512) at
+    T=16k/32k/64k (59.4 vs 42.6 TFLOPs at 64k), so auto selects
+    1024x1024 from T>=16k. ``_pick_block`` clamps both to the sequence
+    length so shorter/odd shapes still tile.
 
     Falls back to ``dense_attention`` when T doesn't tile (no power-of-two
     block divides it) or the head dim isn't sublane-aligned — the numerics
@@ -489,6 +509,10 @@ def flash_attention(
         )
 
     t, d = q.shape[1], q.shape[3]
+    if block_q is None:
+        block_q = _auto_block(t)
+    if block_k is None:
+        block_k = _auto_block(t)
     bq = _pick_block(t, min(block_q, t))
     bk = _pick_block(t, min(block_k, t))
     if bq is None or bk is None or d % 32 != 0:

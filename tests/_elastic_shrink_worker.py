@@ -1,0 +1,62 @@
+"""Per-process supervisor half of the smaller-slice continuation test.
+
+Launched (once per simulated host) by tests/test_elastic_multiprocess.py::
+test_multiprocess_shrink_to_survivors. Host 0 (the COORDINATOR) is killed
+permanently: its child hard-faults at step 9 and its supervisor has
+max_restarts=0 — the moral equivalent of a host that never comes back.
+Host 1 must detect the dead peer through the membership heartbeats, elect
+itself rank 0 of a 1-process world, and finish the run from the last
+checkpoint (Orbax resharding restore: the 4-device data sharding lands on
+its 2 local devices).
+
+Env contract: FRL_TPU_COORDINATOR, FRL_TPU_NUM_PROCESSES,
+FRL_TPU_PROCESS_ID, FRL_TEST_WORKDIR; FRL_FAULT_AT_STEP on host 0 only;
+FRL_TPU_INIT_TIMEOUT_S bounds the dead-coordinator rendezvous wait;
+FRL_TPU_HOST_ADDRESS pins published endpoints to loopback.
+"""
+
+import os
+import sys
+
+
+def main() -> int:
+    from frl_distributed_ml_scaffold_tpu.launcher.launch import main as launch_main
+
+    pid = os.environ["FRL_TPU_PROCESS_ID"]
+    per_host = (
+        # The doomed coordinator: one fault, zero restarts. shrink_after
+        # stays >0 so its supervisor joins the membership directory and
+        # retires (removes its heartbeat) on the way out.
+        ["elastic.max_restarts=0"]
+        if pid == "0"
+        else []
+    )
+    return launch_main(
+        [
+            "--config", "mnist_mlp",
+            "--device", "cpu",
+            "--sim-devices", "2",
+            "--coordinator", os.environ["FRL_TPU_COORDINATOR"],
+            "--num-processes", os.environ["FRL_TPU_NUM_PROCESSES"],
+            "--process-id", pid,
+            "--elastic",
+            "trainer.total_steps=12",
+            "trainer.log_every=4",
+            "trainer.eval_every=0",
+            "data.global_batch_size=64",
+            "data.prefetch=0",
+            "model.hidden_sizes=32",
+            "precision.policy=fp32",
+            "checkpoint.save_every=4",
+            "checkpoint.async_save=false",
+            "elastic.backoff_s=0.1",
+            "elastic.shrink_after=2",
+            "elastic.peer_timeout_s=8",
+            "workdir=" + os.environ["FRL_TEST_WORKDIR"],
+        ]
+        + per_host
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

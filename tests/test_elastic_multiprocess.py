@@ -53,3 +53,54 @@ def test_multiprocess_kill_and_resume(tmp_path):
         int(d) for d in os.listdir(os.path.join(run_dir, "ckpt")) if d.isdigit()
     )
     assert 12 in ckpt_steps
+
+
+def test_multiprocess_shrink_to_survivors(tmp_path):
+    """Smaller-slice continuation (SURVEY C14 "re-initialize (possibly
+    smaller slice)", call stack (d) "re-rendezvous with surviving nodes"):
+    the COORDINATOR host dies permanently (fault + zero restart budget);
+    the surviving host's supervisor fails one full-size restart against the
+    dead coordinator, reads the membership heartbeats, shrinks to a
+    1-process world with itself as rank 0, and finishes the run from the
+    last sharded checkpoint — no step duplicated or lost."""
+    env_base = rendezvous_env(tmp_path, free_port(), device_count=2)
+    envs = []
+    for pid in range(2):
+        env = {
+            **env_base,
+            "FRL_TPU_PROCESS_ID": str(pid),
+            # Bound the dead-coordinator rendezvous: the shrink decision
+            # happens after this timeout fails the full-size restart.
+            "FRL_TPU_INIT_TIMEOUT_S": "15",
+            "FRL_TPU_HOST_ADDRESS": "127.0.0.1",
+        }
+        if pid == 0:
+            env["FRL_FAULT_AT_STEP"] = "9"
+        envs.append(env)
+    rcs, outputs = run_workers("_elastic_shrink_worker.py", envs, timeout=280)
+
+    # Host 0: the fault's exit code surfaces (budget 0, never restarted).
+    assert rcs[0] == 43, f"coordinator supervisor:\n{outputs[0][-3000:]}"
+    assert "fault injection: hard-exit" in outputs[0]
+    # Host 1: survived, shrank, completed.
+    assert rcs[1] == 0, f"survivor supervisor:\n{outputs[1][-3000:]}"
+    assert "elastic: shrinking from 2 to 1" in outputs[1], outputs[1][-3000:]
+    assert "elastic: run completed" in outputs[1]
+    assert "fault injection" not in outputs[1]
+
+    run_dir = os.path.join(str(tmp_path), "mnist_mlp")
+    # Proof of resume-not-restart across the topology change: run 1
+    # (2 hosts, host 0 was rank 0) logs steps 4 and 8 then dies after 9;
+    # the shrunk run (host 1 as the new rank 0) restores the step-8
+    # checkpoint and logs only 12 — same append-only metrics.jsonl.
+    with open(os.path.join(run_dir, "metrics.jsonl")) as fh:
+        steps = [json.loads(line)["step"] for line in fh]
+    assert steps == [4, 8, 12], steps
+    ckpt_steps = sorted(
+        int(d) for d in os.listdir(os.path.join(run_dir, "ckpt")) if d.isdigit()
+    )
+    assert 12 in ckpt_steps
+    # The dead host retired its heartbeat; the survivor's is the only one
+    # left (it retires on clean exit too — directory may also be empty).
+    members = os.listdir(os.path.join(run_dir, "members"))
+    assert "host_0.json" not in members, members

@@ -36,9 +36,23 @@ def timed_steps(trainer, state, batch, n=12, warm=3):
 
 
 def build(name, overrides):
+    import gc
+
+    import jax
+
     from frl_distributed_ml_scaffold_tpu.config import apply_overrides, get_config
     from frl_distributed_ml_scaffold_tpu.trainer.loop import Trainer
 
+    # Release the previous combo's buffers BEFORE allocating this one's:
+    # sweeping big configs in one process otherwise accumulates the old
+    # trainer's params/opt-state/executables (reference cycles defer GC;
+    # the jit cache pins executables) until HBM-heavy combos that fit in
+    # isolation die with RESOURCE_EXHAUSTED — exactly what the first
+    # on-chip run of gpt2_opt produced (evidence_r4/perf_sweep.log:
+    # 17/18 combos failed after combo 1 succeeded).
+    gc.collect()
+    jax.clear_caches()
+    gc.collect()
     cfg = apply_overrides(
         get_config(name),
         ["data.prefetch=0", "trainer.log_every=1000000"] + overrides,
@@ -60,21 +74,29 @@ def emit(tag, bs, dt, extra=None):
     print(json.dumps(rec), flush=True)
 
 
+
+def measure(name, overrides, n=12, warm=3):
+    """Build -> time -> release. Holds no refs to the previous combo while
+    the next one allocates (build() collects the garbage); use this for
+    every multi-combo sweep over HBM-heavy configs."""
+    t, s, b = build(name, overrides)
+    dt, s = timed_steps(t, s, b, n=n, warm=warm)
+    del t, s, b
+    return dt
+
 def rn50_bs():
     """Throughput knee: where does adding batch stop helping?"""
     for bs in (256, 512, 768, 1024):
-        t, s, b = build("imagenet_rn50_ddp", [f"data.global_batch_size={bs}"])
-        dt, _ = timed_steps(t, s, b)
+        dt = measure("imagenet_rn50_ddp", [f"data.global_batch_size={bs}"])
         emit("rn50_bs", bs, dt)
 
 
 def rn50_precision():
     for policy in ("bf16_mixed", "bf16", "fp32"):
-        t, s, b = build(
+        dt = measure(
             "imagenet_rn50_ddp",
             ["data.global_batch_size=512", f"precision.policy={policy}"],
         )
-        dt, _ = timed_steps(t, s, b)
         emit("rn50_precision", 512, dt, {"policy": policy})
 
 
@@ -99,22 +121,20 @@ def rn50_depth():
     """Stem vs body: depth-18 shares the stem; scaling with depth separates
     the (fixed) stem+head cost from the residual body."""
     for depth in (18, 34, 50):
-        t, s, b = build(
+        dt = measure(
             "imagenet_rn50_ddp",
             ["data.global_batch_size=512", f"model.depth={depth}"],
         )
-        dt, _ = timed_steps(t, s, b)
         emit("rn50_depth", 512, dt, {"depth": depth})
 
 
 def rn50_stem():
     """conv7 vs the exact space-to-depth rewrite (MLPerf stem)."""
     for stem in ("conv7", "s2d"):
-        t, s, b = build(
+        dt = measure(
             "imagenet_rn50_ddp",
             ["data.global_batch_size=512", f"model.stem={stem}"],
         )
-        dt, _ = timed_steps(t, s, b)
         emit("rn50_stem", 512, dt, {"stem": stem})
 
 
@@ -163,8 +183,7 @@ def rn50_split():
 
 def vitb():
     for bs in (128, 256, 512):
-        t, s, b = build("imagenet_vitb_fsdp", [f"data.global_batch_size={bs}"])
-        dt, _ = timed_steps(t, s, b)
+        dt = measure("imagenet_vitb_fsdp", [f"data.global_batch_size={bs}"])
         emit("vitb_bs", bs, dt)
 
 
@@ -188,12 +207,12 @@ def rn50_pool():
     """select_and_scatter vs the mask-based custom-VJP maxpool backward
     (models/resnet.py::_max_pool_mask_grad) on the headline candidate."""
     for pg in ("scatter", "mask"):
-        t, s, b = build(
+        dt = measure(
             "imagenet_rn50_ddp",
             ["data.global_batch_size=512", "model.stem=s2d",
              f"model.pool_grad={pg}"],
+            n=30, warm=4,
         )
-        dt, _ = timed_steps(t, s, b, n=30, warm=4)
         emit("rn50_pool", 512, dt, {"pool_grad": pg})
 
 
@@ -214,15 +233,15 @@ def gpt2_opt():
             for remat in ("dots", "none"):
                 tag = {"optimizer": opt, "remat": remat}
                 try:
-                    t, s, b = build(
+                    dt = measure(
                         "gpt2_medium_zero1",
                         base + [
                             f"optimizer.name={opt}",
                             f"data.global_batch_size={mb}",
                             f"trainer.remat={remat}",
                         ],
+                        n=10, warm=3,
                     )
-                    dt, _ = timed_steps(t, s, b, n=10, warm=3)
                     emit("gpt2_opt", mb, dt, tag)
                 except Exception as e:
                     print(
@@ -248,26 +267,26 @@ def gpt2_block_remat():
         "trainer.remat=none",
     ]
     # Protocol baseline first so every run of this group is self-contained.
-    t, s, b = build(
+    dt = measure(
         "gpt2_medium_zero1",
         ["model.attention=flash", "model.lm_loss_chunk=128",
          "trainer.grad_accum=1", "data.global_batch_size=4",
          "trainer.remat=dots"],
+        n=10, warm=3,
     )
-    dt, _ = timed_steps(t, s, b, n=10, warm=3)
     emit("gpt2_block_remat", 4, dt, {"remat": "dots", "block_remat": "none"})
     for br in ("save_attn", "full"):
         for mb in (8, 16, 32):
             tag = {"remat": "none", "block_remat": br}
             try:
-                t, s, b = build(
+                dt = measure(
                     "gpt2_medium_zero1",
                     base + [
                         f"model.block_remat={br}",
                         f"data.global_batch_size={mb}",
                     ],
+                    n=10, warm=3,
                 )
-                dt, _ = timed_steps(t, s, b, n=10, warm=3)
                 emit("gpt2_block_remat", mb, dt, tag)
             except Exception as e:
                 print(
@@ -293,15 +312,15 @@ def gpt2_offload():
     for opt in ("adamw", "adafactor"):
         for mb in (8, 16, 32):
             try:
-                t, s, b = build(
+                dt = measure(
                     "gpt2_medium_zero1",
                     base + [
                         f"optimizer.name={opt}",
                         f"data.global_batch_size={mb}",
                         "trainer.remat=dots",
                     ],
+                    n=8, warm=3,
                 )
-                dt, _ = timed_steps(t, s, b, n=8, warm=3)
                 emit("gpt2_offload", mb, dt, {"optimizer": opt})
             except Exception as e:
                 print(
@@ -319,12 +338,12 @@ def rn50_fused_opt():
     the single-Pallas-pass fused_adamw (ops/fused_adamw.py). Ship
     fused_adamw as a recommendation only if this measures a win."""
     for opt in ("sgd", "adamw", "fused_adamw"):
-        t, s, b = build(
+        dt = measure(
             "imagenet_rn50_ddp",
             ["data.global_batch_size=512", "model.stem=s2d",
              f"optimizer.name={opt}"],
+            n=30, warm=4,
         )
-        dt, _ = timed_steps(t, s, b, n=30, warm=4)
         emit("rn50_fused_opt", 512, dt, {"optimizer": opt})
 
 
