@@ -664,3 +664,40 @@ def test_moe_auto_groups_align_with_batch_dim():
         # gcd(2, 8) = 2, never 8 (which divides n=32 but cuts sequences).
         assert _num_groups(moe, 32, 2, True) == 2
         assert _num_groups(moe, 64, 8, True) == 8
+
+
+def test_moe_grouped_dispatch_residual_ordering():
+    """CI-light pin of the tools/pp_memory_audit.py --moe conclusion: the
+    grouped (GSEC) dispatch saves strictly fewer fwd→bwd residual bytes
+    than ungrouped (per-group capacity shrinks the [G,S,E,C] one-hots G×),
+    and per-block remat collapses the dispatch residual class entirely —
+    which is why a sort-based dispatch is NOT shipped (measured at real
+    shapes: 6.04 GB → 1.51 GB → 0.05 GB, docs/perf_playbook.md)."""
+    from jax._src.ad_checkpoint import saved_residuals
+
+    tokens = (jnp.arange(64, dtype=jnp.int32).reshape(4, 16)) % 64
+
+    def residual_bytes(groups, block_remat):
+        model = create_model(
+            tiny_gpt(
+                moe=MoEConfig(num_experts=4, top_k=2, num_groups=groups),
+                block_remat=block_remat,
+            ),
+            FP32,
+        )
+        params = jit_init(model, tokens, train=False)
+
+        def loss(p):
+            logits, aux = model.apply(p, tokens, train=True)
+            return jnp.mean(logits.astype(jnp.float32) ** 2) + aux
+
+        total = 0
+        for aval, _ in saved_residuals(loss, params):
+            if hasattr(aval, "shape"):
+                total += int(aval.size) * aval.dtype.itemsize
+        return total
+
+    ungrouped = residual_bytes(1, "none")
+    grouped = residual_bytes(4, "none")
+    remat = residual_bytes(4, "full")
+    assert remat < grouped < ungrouped, (remat, grouped, ungrouped)

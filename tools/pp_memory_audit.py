@@ -209,6 +209,102 @@ def flagship_one(mb: int, remat: str, block_remat: str) -> dict:
     return rec
 
 
+def moe_one(g: int, batch: int, experts: int, block_remat: str) -> dict:
+    """Residual audit of the FULL MoE train step at real routed shapes
+    (VERDICT r3 next-round #5): N = batch*1024 tokens, E experts, k=2,
+    G routing groups. Separates the dispatch/combine one-hot tensors
+    ([G, S, E, C] — the GSEC memory story) from everything else."""
+    import jax
+    import jax.numpy as jnp
+    from jax._src.ad_checkpoint import saved_residuals
+
+    from frl_distributed_ml_scaffold_tpu.config import apply_overrides, get_config
+    from frl_distributed_ml_scaffold_tpu.trainer.loop import Trainer
+    from frl_distributed_ml_scaffold_tpu.trainer.tasks import example_input
+
+    cfg = apply_overrides(
+        get_config("gpt2_moe"),
+        [
+            f"data.global_batch_size={batch}",
+            f"model.moe.num_experts={experts}",
+            f"model.moe.num_groups={g}",
+            "model.attention=flash",
+            "model.lm_loss_chunk=128",
+            f"model.block_remat={block_remat}",
+            "trainer.grad_accum=1",
+            "checkpoint.enabled=false",
+            "data.prefetch=0",
+            "mesh.data=1", "mesh.fsdp=1", "mesh.model=1",
+            "mesh.pipe=1", "mesh.seq=1", "mesh.expert=1",
+        ],
+    )
+    trainer = Trainer(cfg)
+    example = {
+        k: jnp.asarray(v)
+        for k, v in example_input(
+            cfg.data, cfg.model, batch_size=batch
+        ).items()
+    }
+
+    def scalar_loss(params):
+        loss, _ = trainer.loss_fn(
+            params, trainer.state_shapes.extras, example,
+            jax.random.key(0), True,
+        )
+        return loss
+
+    res = trainer._mesh_scoped(saved_residuals)(
+        scalar_loss, trainer.state_shapes.params
+    )
+    total, by_shape = _residual_bytes(res)
+    param_bytes = sum(
+        int(l.size) * l.dtype.itemsize
+        for l in jax.tree.leaves(trainer.state_shapes.params)
+    )
+    n = batch * cfg.model.seq_len
+    s = n // g
+    e = experts
+    cap = max(1, int(cfg.model.moe.capacity_factor * s * 2 / e))
+    # Dispatch/combine and their einsum partners carry the capacity dim —
+    # count every residual whose trailing dims look like [.., E, C] or
+    # [E, .., C, ..] (expert_in/out are [E, G, C, D]).
+    gsec = sum(
+        b for shape, b in by_shape.items()
+        if (len(shape) >= 3 and shape[-2:] == (e, cap))
+        or (len(shape) == 4 and shape[0] == e and shape[2] == cap)
+    )
+    rec = {
+        "groups": g,
+        "batch": batch,
+        "experts": e,
+        "capacity": cap,
+        "block_remat": block_remat,
+        "residual_minus_params_mb": round((total - param_bytes) / 1e6, 1),
+        "gsec_tensors_mb": round(gsec / 1e6, 1),
+        "other_mb": round((total - param_bytes - gsec) / 1e6, 1),
+    }
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+def moe_main(args) -> int:
+    rows = []
+    for br in ("none", "full"):
+        for g in args.groups:
+            rows.append(moe_one(g, args.batch, args.experts, br))
+    print(
+        f"\n{'G':>3s} {'block_remat':>11s} {'cap':>5s} "
+        f"{'activations MB':>15s} {'GSEC MB':>9s} {'other MB':>9s}"
+    )
+    for r in rows:
+        print(
+            f"{r['groups']:3d} {r['block_remat']:>11s} {r['capacity']:5d} "
+            f"{r['residual_minus_params_mb']:15.1f} "
+            f"{r['gsec_tensors_mb']:9.1f} {r['other_mb']:9.1f}"
+        )
+    return 0
+
+
 def flagship_main(args) -> int:
     variants = [
         ("dots", "none"),   # the round-3 protocol line (mb4 knee)
@@ -251,12 +347,17 @@ def main() -> int:
                     help="single-chip GPT-2-medium remat-mode sweep")
     ap.add_argument("--mb", type=int, nargs="+", default=[4, 8, 16],
                     help="--flagship microbatch sizes")
+    ap.add_argument("--moe", action="store_true",
+                    help="MoE dispatch-memory audit at real routed shapes")
+    ap.add_argument("--groups", type=int, nargs="+", default=[1, 8, 32],
+                    help="--moe routing-group counts")
+    ap.add_argument("--experts", type=int, default=64)
     args = ap.parse_args()
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
-        # Flagship mode audits the one-chip config — a single CPU device
-        # keeps the mesh honest; the PP audit needs the 8-device sim.
-        n = 1 if args.flagship else 8
+        # Flagship/MoE modes audit the one-chip config — a single CPU
+        # device keeps the mesh honest; the PP audit needs the 8-device sim.
+        n = 1 if (args.flagship or args.moe) else 8
         os.environ["XLA_FLAGS"] = (
             flags + f" --xla_force_host_platform_device_count={n}"
         ).strip()
@@ -265,6 +366,8 @@ def main() -> int:
     jax.config.update("jax_platforms", "cpu")
     if args.flagship:
         return flagship_main(args)
+    if args.moe:
+        return moe_main(args)
 
     gpipe_ov = [
         f"model.pipeline_stages={args.stages}",
