@@ -203,6 +203,103 @@ ALL_CONFIGS = [
 ]
 
 
+def _ensure_bench_shards(dir_: str, n_shards: int = 4, per: int = 256,
+                         size: int = 224) -> str:
+    """Generate (once, then reuse) uint8 decoded-image shards at RN50/ViT
+    shapes — the exact on-disk format tools/decode_imagenet.py produces.
+    Contents are random: the loader bench measures gather+augment+feed
+    throughput, which is content-independent."""
+    import numpy as np
+
+    os.makedirs(dir_, exist_ok=True)
+    for s in range(n_shards):
+        ip = os.path.join(dir_, f"train_images_{s:03d}.npy")
+        lp = os.path.join(dir_, f"train_labels_{s:03d}.npy")
+        if not (os.path.exists(ip) and os.path.exists(lp)):
+            rng = np.random.default_rng(s)
+            np.save(ip, rng.integers(
+                0, 256, size=(per, size, size, 3), dtype=np.uint8))
+            np.save(lp, rng.integers(0, 1000, size=per))
+    return dir_
+
+
+def run_real_data() -> int:
+    """SURVEY §7 hard part 5: does samples/sec/chip measure the chip or the
+    loader? Streams a FRESH batch through the full input tier every step —
+    disk shards → memmap gather → native augment → device feed — and
+    compares against the identical streaming loop on the synthetic source.
+    One JSONL row per mode plus a verdict row. (The protocol benchmark
+    deliberately reuses one device-resident batch; this mode exists to
+    check that choice against reality.)
+
+    Honesty note: on the axon relay, host→device feeding crosses the
+    tunnel, which is NOT representative of production pod infeed
+    bandwidth — the verdict row carries the feed path so the comparison
+    reads as what it is.
+    """
+    _respect_platform_env()
+    kind, probe_err = probe_backend()
+    if probe_err is not None:
+        print(json.dumps({"mode": "_probe", "error": probe_err}), flush=True)
+        return 1
+    import time as _time
+
+    from frl_distributed_ml_scaffold_tpu.config import apply_overrides, get_config
+    from frl_distributed_ml_scaffold_tpu.launcher.launch import enable_compile_cache
+    from frl_distributed_ml_scaffold_tpu.trainer.loop import Trainer
+
+    enable_compile_cache()
+    shard_dir = _ensure_bench_shards(
+        os.environ.get("FRL_BENCH_DATA_DIR", "/tmp/frl_bench_shards")
+    )
+    bs, warm, steps = 256, 3, 12
+    rows = {}
+    for mode, extra in (
+        ("synthetic_stream", []),
+        ("real_stream", [f"data.data_dir={shard_dir}"]),
+    ):
+        cfg = apply_overrides(
+            get_config("imagenet_rn50_ddp"),
+            [f"data.global_batch_size={bs}", "model.stem=s2d",
+             "trainer.log_every=1000000", "data.prefetch=2"] + extra,
+        )
+        trainer = Trainer(cfg)
+        # prefetch>0 wraps the pipeline; the source lives on the inner one.
+        inner = getattr(trainer.pipeline, "_p", trainer.pipeline)
+        if mode == "real_stream" and inner.source.is_synthetic:
+            raise RuntimeError("real-data shards not picked up")
+        state = trainer.init_state()
+        for step in range(warm):
+            state, m = trainer.train_step(
+                state, trainer.pipeline.global_batch(step)
+            )
+        import jax
+
+        jax.device_get(m["loss"])
+        t0 = _time.perf_counter()
+        for step in range(warm, warm + steps):
+            state, m = trainer.train_step(
+                state, trainer.pipeline.global_batch(step)
+            )
+        jax.device_get(m["loss"])
+        dt = (_time.perf_counter() - t0) / steps
+        rows[mode] = bs / dt
+        print(json.dumps({
+            "mode": mode, "global_batch_size": bs,
+            "step_time_ms": round(dt * 1e3, 2),
+            "samples_per_sec_per_chip": round(bs / dt, 1),
+        }), flush=True)
+        del trainer, state, m
+    ratio = rows["real_stream"] / rows["synthetic_stream"]
+    print(json.dumps({
+        "mode": "verdict",
+        "real_over_synthetic": round(ratio, 4),
+        "loader_bound": bool(ratio < 0.9),
+        "feed_path": "host->relay tunnel (not production infeed)",
+    }), flush=True)
+    return 0
+
+
 def run_all(out_path: str = "BENCH_TABLE.jsonl") -> int:
     """Benchmark every BASELINE config; emit protocol JSONL + a table."""
     _respect_platform_env()
@@ -370,6 +467,8 @@ def probe_backend() -> tuple[str | None, str | None]:
 def main() -> int:
     if "--all" in sys.argv:
         return run_all()
+    if "--real-data" in sys.argv:
+        return run_real_data()
     if "--child" in sys.argv:
         return child_main(sys.argv[sys.argv.index("--child") + 1])
 

@@ -82,6 +82,52 @@ def main() -> int:
         s2, m2 = t2.train_step(s2, b2)
     out["dcn_loss"] = round(float(jax.device_get(m2["loss"])), 6)
 
+    # Per-host distinct-batch contract over a REAL on-disk corpus (SURVEY
+    # C16 "sharded per-host input"): each process draws its own sample
+    # indices (host_offset folds into the sampling rng) and the global
+    # batch assembles every host's local slice into the right global
+    # shards (jax.make_array_from_process_local_data path). The corpus is
+    # written by the parent test: constant-valued images whose pixel value
+    # encodes the sample index, labels = index — so pairing survives
+    # gather + augment (flip/crop of a constant image is the identity;
+    # normalization is invertible).
+    from frl_distributed_ml_scaffold_tpu.config.schema import DataConfig
+    from frl_distributed_ml_scaffold_tpu.data.native import (
+        _IMAGENET_MEAN,
+        _IMAGENET_STD,
+    )
+    from frl_distributed_ml_scaffold_tpu.data.pipeline import build_pipeline
+
+    corpus_dir = os.path.join(os.environ["FRL_TEST_WORKDIR"], "corpus")
+    dcfg = DataConfig(
+        name="imagenet", data_dir=corpus_dir, global_batch_size=16,
+        image_size=8, channels=3, num_classes=256, prefetch=0,
+    )
+    pipe = build_pipeline(dcfg, trainer.env, split="train")
+    inner = getattr(pipe, "_p", pipe)
+    assert not inner.source.is_synthetic, "corpus not picked up"
+    local = pipe.local_batch(0)
+    out["rd_local_labels"] = np.asarray(local["label"]).astype(int).tolist()
+    # Pixel value decodes back to the sample index: pairing preserved
+    # through the native gather + augment path.
+    decoded = (
+        np.asarray(local["image"])[:, 0, 0, 0] * _IMAGENET_STD[0]
+        + _IMAGENET_MEAN[0]
+    ) * 255.0
+    out["rd_pixel_decode_ok"] = bool(
+        np.allclose(decoded, np.asarray(local["label"]), atol=1.0)
+    )
+    gb = pipe.global_batch(0)
+    shards = sorted(
+        gb["label"].addressable_shards, key=lambda s: s.index[0].start or 0
+    )
+    mine = np.concatenate([np.asarray(s.data) for s in shards]).astype(int)
+    # This process's addressable slice of the GLOBAL batch must be exactly
+    # the local draw, in order.
+    out["rd_global_matches_local"] = bool(
+        np.array_equal(mine, np.asarray(local["label"]).astype(int))
+    )
+
     print("CHECK " + json.dumps(out), flush=True)
     shutdown_distributed()
     return 0

@@ -1,0 +1,225 @@
+#!/usr/bin/env python
+"""Offline compressed-video → clip-shard producer for the video loader (C16).
+
+The Ego4D-analogue training path reads pre-decoded fixed-shape clip shards
+(``{split}_clips_XXX.npy (N,T,H,W,C)`` + labels — data/video.py) because
+per-step container decode on the host would starve the chip (SURVEY §7
+hard part 5). This is the producer half for real compressed footage,
+mirroring tools/decode_imagenet.py: decode OFFLINE with TensorFlow's C++
+image decoders (IO-only tooling — tf never touches the training path),
+then shard.
+
+Supported raw layouts (both the standard frame-extracted convention and
+the one compressed container tf can decode without ffmpeg):
+
+    <raw_dir>/<split>/<class>/<video_id>/*.jpg    frame-JPEG directories
+    <raw_dir>/<split>/<class>/<video>.gif         animated GIF containers
+
+MP4/AVI need an ffmpeg/decord stack this zero-egress image doesn't ship;
+extract frames with ``ffmpeg -i v.mp4 v/frame_%05d.jpg`` wherever ffmpeg
+lives, then point this tool at the frame tree — that is the standard
+Ego4D preprocessing shape anyway.
+
+    python tools/decode_video.py <raw_dir> <out_dir> --split train \
+        [--frames 8] [--frame-stride 1] [--clip-stride 0(=frames)] \
+        [--size 64] [--shard-items 256] [--dtype uint8|float32] [--limit N]
+
+Each video yields every full window of ``frames`` frames (temporal
+subsample ``--frame-stride``, window hop ``--clip-stride``); frames are
+short-side resized and center-cropped to ``size x size``. Labels are the
+sorted class-directory order. ``--dtype uint8`` stores 0-255 at 1/4 the
+disk; the shared shard gather (data/shards.py → native.gather_rows)
+rescales to [0,1] float32, so stored dtype never changes training
+statistics — same contract as the ImageNet producer.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+import numpy as np
+
+_FRAME_EXTS = (".jpeg", ".jpg", ".png", ".bmp")
+
+
+def _frame_paths(video_dir: str) -> list[str]:
+    return sorted(
+        p
+        for p in glob.glob(os.path.join(video_dir, "*"))
+        if os.path.isfile(p) and p.lower().endswith(_FRAME_EXTS)
+    )
+
+
+def _resize_center_crop(frames, size: int):
+    """(T, H, W, 3) uint8/float -> (T, size, size, 3) float32 [0,1] via
+    tf's antialiased resize — one call for the whole clip."""
+    import tensorflow as tf
+
+    t = tf.convert_to_tensor(frames)
+    h, w = t.shape[1], t.shape[2]
+    short = min(h, w)
+    scale = size / short
+    nh, nw = int(np.ceil(h * scale)), int(np.ceil(w * scale))
+    t = tf.image.resize(tf.cast(t, tf.float32), (nh, nw), antialias=True)
+    top, left = (nh - size) // 2, (nw - size) // 2
+    t = t[:, top : top + size, left : left + size, :]
+    return np.clip(t.numpy() / 255.0, 0.0, 1.0).astype(np.float32)
+
+
+def iter_videos(split_dir: str, classes: list[str]):
+    """Yield (label, list-of-frame-arrays-or-paths) per video, in sorted
+    order. Frame dirs yield path lists (decoded lazily per frame); GIFs
+    decode in one shot."""
+    import tensorflow as tf
+
+    for label, cls in enumerate(classes):
+        cdir = os.path.join(split_dir, cls)
+        for entry in sorted(glob.glob(os.path.join(cdir, "*"))):
+            if os.path.isdir(entry):
+                paths = _frame_paths(entry)
+                if paths:
+                    yield label, entry, paths
+            elif entry.lower().endswith(".gif"):
+                try:
+                    gif = tf.io.decode_image(
+                        tf.io.read_file(entry), expand_animations=True
+                    ).numpy()  # (T, H, W, C)
+                except Exception as e:  # undecodable: skip, don't crash
+                    print(f"skipping {entry}: {e}", file=sys.stderr)
+                    continue
+                if gif.ndim == 4 and gif.shape[0] >= 1:
+                    if gif.shape[-1] == 1:
+                        gif = np.repeat(gif, 3, axis=-1)
+                    yield label, entry, gif[..., :3]
+
+
+def decode_frames(paths_or_array):
+    """Frame path list -> (T, H, W, 3) uint8; arrays pass through."""
+    if isinstance(paths_or_array, np.ndarray):
+        return paths_or_array
+    import tensorflow as tf
+
+    frames = []
+    for p in paths_or_array:
+        try:
+            img = tf.io.decode_image(
+                tf.io.read_file(p), channels=3, expand_animations=False
+            ).numpy()
+        except Exception as e:
+            print(f"skipping frame {p}: {e}", file=sys.stderr)
+            continue
+        frames.append(img)
+    if not frames:
+        return np.zeros((0, 1, 1, 3), np.uint8)
+    # A real frame dump has one resolution per video; enforce rather than
+    # silently stack-fail hours in.
+    shapes = {f.shape for f in frames}
+    if len(shapes) > 1:
+        print(
+            f"skipping video with mixed frame shapes {shapes}",
+            file=sys.stderr,
+        )
+        return np.zeros((0, 1, 1, 3), np.uint8)
+    return np.stack(frames)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("raw_dir", help="root holding <split>/<class>/<video>")
+    ap.add_argument("out_dir")
+    ap.add_argument("--split", default="train")
+    ap.add_argument("--frames", type=int, default=8,
+                    help="frames per stored clip (data.num_frames)")
+    ap.add_argument("--frame-stride", type=int, default=1,
+                    help="temporal subsampling within a window")
+    ap.add_argument("--clip-stride", type=int, default=0,
+                    help="window hop in source frames (0 = frames * "
+                         "frame_stride: non-overlapping)")
+    ap.add_argument("--size", type=int, default=64,
+                    help="stored side; must equal data.image_size")
+    ap.add_argument("--shard-items", type=int, default=256)
+    ap.add_argument("--dtype", default="uint8", choices=["uint8", "float32"])
+    ap.add_argument("--limit", type=int, default=0,
+                    help="stop after N clips (0 = all; for smoke runs)")
+    args = ap.parse_args()
+
+    split_dir = os.path.join(args.raw_dir, args.split)
+    classes = sorted(
+        d for d in os.listdir(split_dir)
+        if os.path.isdir(os.path.join(split_dir, d))
+    )
+    if not classes:
+        print(f"no class directories under {split_dir}", file=sys.stderr)
+        return 2
+
+    span = args.frames * args.frame_stride
+    hop = args.clip_stride or span
+    os.makedirs(args.out_dir, exist_ok=True)
+    buf_x, buf_y, shard_idx, written, videos = [], [], 0, 0, 0
+
+    def flush():
+        nonlocal buf_x, buf_y, shard_idx
+        if not buf_x:
+            return
+        np.save(
+            os.path.join(
+                args.out_dir, f"{args.split}_clips_{shard_idx:03d}.npy"
+            ),
+            np.stack(buf_x),
+        )
+        np.save(
+            os.path.join(
+                args.out_dir, f"{args.split}_labels_{shard_idx:03d}.npy"
+            ),
+            np.asarray(buf_y, np.int32),
+        )
+        shard_idx += 1
+        buf_x, buf_y = [], []
+
+    done = False
+    for label, name, frames_src in iter_videos(split_dir, classes):
+        if done:
+            break
+        raw = decode_frames(frames_src)
+        if len(raw) < span:
+            print(
+                f"skipping {name}: {len(raw)} frames < window {span}",
+                file=sys.stderr,
+            )
+            continue
+        videos += 1
+        clip_stack = _resize_center_crop(raw, args.size)
+        for start in range(0, len(clip_stack) - span + 1, hop):
+            clip = clip_stack[start : start + span : args.frame_stride]
+            if args.dtype == "uint8":
+                clip = np.round(clip * 255.0).astype(np.uint8)
+            buf_x.append(clip)
+            buf_y.append(label)
+            written += 1
+            if len(buf_x) >= args.shard_items:
+                flush()
+            if args.limit and written >= args.limit:
+                done = True
+                break
+    flush()
+    meta = {
+        "split": args.split, "clips": written, "videos": videos,
+        "classes": len(classes), "frames": args.frames,
+        "frame_stride": args.frame_stride, "clip_stride": hop,
+        "size": args.size, "dtype": args.dtype, "shards": shard_idx,
+        "class_names": classes,
+    }
+    with open(
+        os.path.join(args.out_dir, f"{args.split}_meta.json"), "w"
+    ) as fh:
+        json.dump(meta, fh, indent=2)
+    print(json.dumps(meta))
+    return 0 if written else 3
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
