@@ -55,6 +55,55 @@ CHIP_PEAK_FLOPS = {
 PROBE_TIMEOUT_S = int(os.environ.get("FRL_BENCH_PROBE_TIMEOUT_S", "240"))
 CANDIDATE_TIMEOUT_S = int(os.environ.get("FRL_BENCH_CANDIDATE_TIMEOUT_S", "720"))
 
+#: Last successfully-captured headline result (committed evidence). Written
+#: on every green headline run; re-emitted marked ``"stale": true`` when the
+#: relay is down at bench time, so an outage degrades the record to "most
+#: recent real measurement + its capture timestamp" instead of an error
+#: object that carries no performance information at all.
+LAST_GOOD_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "bench_last_good.json")
+
+
+def _save_last_good(result: dict) -> None:
+    try:
+        rec = dict(result)
+        rec["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        with open(LAST_GOOD_PATH + ".tmp", "w") as fh:
+            json.dump(rec, fh, indent=2)
+        os.replace(LAST_GOOD_PATH + ".tmp", LAST_GOOD_PATH)
+    except OSError as e:  # evidence cache is best-effort, never fatal
+        _progress(f"could not save last-good record: {e}")
+
+
+def _emit_stale_or_error(error: str) -> int:
+    """Final-line fallback: most recent real measurement marked stale, or —
+    only if none was ever captured — the bare error object.
+
+    Always returns rc=1: the benchmark did NOT run, and anything keying on
+    the exit code must see that. The final line still carries the last real
+    numbers (with ``stale``/``stale_reason``/``captured_at``) so the record
+    of a relay outage is "most recent measurement + when + why stale"
+    rather than an error object with no performance information.
+    """
+    try:
+        with open(LAST_GOOD_PATH) as fh:
+            rec = json.load(fh)
+    except (OSError, ValueError):
+        rec = None
+    if rec and "value" in rec:
+        rec["stale"] = True
+        rec["stale_reason"] = error[:300]
+        _progress(
+            f"relay down ({error[:120]}); re-emitting last good capture "
+            f"from {rec.get('captured_at', 'unknown time')}"
+        )
+        print(json.dumps(rec), flush=True)
+    else:
+        print(json.dumps({"metric": "error", "value": 0, "unit": "",
+                          "vs_baseline": 0, "error": error[:500]}),
+              flush=True)
+    return 1
+
 
 def _progress(msg: str) -> None:
     print(f"[bench {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr, flush=True)
@@ -183,6 +232,19 @@ ALL_CONFIGS = [
         10,
     ),
     (
+        # The recorded optimizer decision (VERDICT r4 #1): adafactor beat
+        # adamw +4.6% at mb4 remat=none on-chip (31.7 vs 30.3,
+        # evidence_r4/perf_sweep2.log) with convergence within tolerance
+        # (tools/opt_convergence.py); this row carries the variant at the
+        # flagship operating point so regenerating the table keeps the
+        # A/B visible next to gpt2_medium_zero1's adamw line.
+        "gpt2_medium_adafactor",
+        ["data.global_batch_size=8", "trainer.grad_accum=1",
+         "model.attention=flash", "model.lm_loss_chunk=128",
+         "trainer.remat=none", "model.block_remat=full"],
+        10,
+    ),
+    (
         # On-chip MoE protocol line (SURVEY C9): single chip has no expert
         # axis to shard (mesh.expert=1 — EP itself is sim-verified), but
         # the grouped GSEC dispatch, capacity routing, z-loss, and the
@@ -289,7 +351,16 @@ def run_real_data() -> int:
             "step_time_ms": round(dt * 1e3, 2),
             "samples_per_sec_per_chip": round(bs / dt, 1),
         }), flush=True)
-        del trainer, state, m
+        del trainer, state, m, inner
+        # Release the first mode's params/opt-state/executables (and the
+        # pipeline's prefetch buffers held via `inner`) before the second
+        # allocates (same settle tools/perf_sweep.py build() uses) —
+        # two live Trainers can RESOURCE_EXHAUSTED an HBM-constrained chip.
+        import gc
+
+        gc.collect()
+        jax.clear_caches()
+        gc.collect()
     ratio = rows["real_stream"] / rows["synthetic_stream"]
     print(json.dumps({
         "mode": "verdict",
@@ -478,9 +549,7 @@ def main() -> int:
     )
     kind, probe_err = probe_backend()
     if probe_err is not None:
-        print(json.dumps({"metric": "error", "value": 0, "unit": "",
-                          "vs_baseline": 0, "error": probe_err}), flush=True)
-        return 1
+        return _emit_stale_or_error(probe_err)
 
     last_err: str = "no candidates ran"
     for metric, cfg_name, overrides, steps in CANDIDATES:
@@ -503,13 +572,12 @@ def main() -> int:
                 result = json.loads(line[len("RESULT "):])
         if rc == 0 and result is not None:
             _progress(f"candidate {cfg_name} done in {dt:.1f}s")
+            _save_last_good(result)
             print(json.dumps(result), flush=True)
             return 0
         last_err = f"{cfg_name}: rc={rc}: {err.strip()[-300:]}"
         _progress(last_err)
-    print(json.dumps({"metric": "error", "value": 0, "unit": "",
-                      "vs_baseline": 0, "error": last_err[:500]}), flush=True)
-    return 1
+    return _emit_stale_or_error(last_err)
 
 
 if __name__ == "__main__":

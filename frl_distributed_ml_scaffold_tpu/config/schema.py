@@ -182,6 +182,13 @@ class ElasticConfig:
     # restart budget runs out, the round-2/3 behavior.
     shrink_after: int = 0
     peer_timeout_s: float = 60.0
+    # Grow-back after a shrink: when a previously-dead host resumes
+    # heartbeating (repaired, or a false-positive eviction), the supervisor
+    # preempts the child (SIGTERM -> checkpoint -> clean exit) and
+    # re-forms at the larger world — ranks remapped by uid, Orbax
+    # resharding restore, no steps lost. false = shrink-only (a wrongly
+    # evicted host then needs operator action, the round-4 behavior).
+    grow: bool = True
 
 
 @dataclass(frozen=True)
@@ -203,6 +210,13 @@ class DataConfig:
     data_dir: Optional[str] = None
     # Batches built ahead on a background thread (0 = synchronous).
     prefetch: int = 2
+    # Online ingestion (data/streaming.py): treat data_dir as APPEND-ONLY
+    # GROWABLE — re-scan every `streaming_refresh_every` steps for newly
+    # sealed shard pairs and widen the sampling window (hosts agree on
+    # the window via the host-tier collective). Determinism contract in
+    # the module docstring. false = the corpus freezes at construction.
+    streaming: bool = False
+    streaming_refresh_every: int = 256
 
 
 # --------------------------------------------------------------------------
@@ -266,6 +280,16 @@ class MoEConfig:
     # with 1/G and capacity is enforced per group. 0 = auto (the mesh's
     # batch-shard count, so each data shard routes its own tokens).
     num_groups: int = 0
+    # Token->expert exchange formulation, identical routing/drop semantics
+    # (seating comes from the same slot-major cumsum either way):
+    #   einsum — one-hot [G,S,E,C] dispatch/combine einsums (GShard); the
+    #            exchange is MACs against mostly-zero one-hots, costing
+    #            O(S*E*C*D) — comparable to the expert FFN itself at
+    #            audited shapes (docs/perf_playbook.md).
+    #   sort   — scatter/gather (ragged) exchange: seat indices are
+    #            scattered into the [E*C] slot table and tokens gathered
+    #            by index; ~zero exchange MACs.
+    dispatch: str = "einsum"  # einsum | sort
 
 
 @dataclass(frozen=True)

@@ -29,7 +29,21 @@ class ImageNet:
         self._corpus = None
         self._train = split == "train"
         if cfg.data_dir:
-            corpus = ShardedNpyCorpus(cfg.data_dir, split, "images")
+            if cfg.streaming and self._train:
+                # Streaming applies to the TRAIN split only: eval keeps
+                # the frozen view (synthetic fallback + warning when its
+                # shards don't exist) — a producer streaming train_* must
+                # not crash the eval pipeline mid-run.
+                from frl_distributed_ml_scaffold_tpu.data.streaming import (
+                    StreamingShardCorpus,
+                )
+
+                corpus = StreamingShardCorpus(
+                    cfg.data_dir, split, "images",
+                    refresh_every=cfg.streaming_refresh_every,
+                )
+            else:
+                corpus = ShardedNpyCorpus(cfg.data_dir, split, "images")
             if corpus.found:
                 shape = corpus.item_shape
                 if min(shape[0], shape[1]) < cfg.image_size:
@@ -54,6 +68,10 @@ class ImageNet:
             return self._fallback.batch(step, batch_size, host_offset)
         from frl_distributed_ml_scaffold_tpu.data import native
 
+        if hasattr(self._corpus, "maybe_refresh"):
+            # Streaming tier: widen the sampling window to newly sealed
+            # shards (host-synchronized; see data/streaming.py).
+            self._corpus.maybe_refresh(step)
         rng = np.random.default_rng((self._seed, step, host_offset))
         idx = np.sort(rng.integers(0, self._corpus.n, size=batch_size))
         size = self.cfg.image_size

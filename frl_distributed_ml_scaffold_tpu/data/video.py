@@ -67,7 +67,19 @@ class VideoClips:
         self._fallback = None
         self._corpus = None
         if cfg.data_dir:
-            corpus = ShardedNpyCorpus(cfg.data_dir, split, "clips")
+            if cfg.streaming and split == "train":
+                # Train split only — eval keeps the frozen view (see
+                # data/imagenet.py for the rationale).
+                from frl_distributed_ml_scaffold_tpu.data.streaming import (
+                    StreamingShardCorpus,
+                )
+
+                corpus = StreamingShardCorpus(
+                    cfg.data_dir, split, "clips",
+                    refresh_every=cfg.streaming_refresh_every,
+                )
+            else:
+                corpus = ShardedNpyCorpus(cfg.data_dir, split, "clips")
             if corpus.found:
                 want = (cfg.num_frames, cfg.image_size, cfg.image_size, cfg.channels)
                 if corpus.item_shape != want:
@@ -90,6 +102,8 @@ class VideoClips:
     def batch(self, step: int, batch_size: int, host_offset: int = 0) -> dict:
         if self._fallback is not None:
             return self._fallback.batch(step, batch_size, host_offset)
+        if hasattr(self._corpus, "maybe_refresh"):
+            self._corpus.maybe_refresh(step)  # see data/streaming.py
         rng = np.random.default_rng((self._seed, step, host_offset))
         idx = np.sort(rng.integers(0, self._corpus.n, size=batch_size))
         x, y = self._corpus.gather(idx)

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import json
 import os
+import signal
 import socket
 import subprocess
 import sys
@@ -93,9 +94,22 @@ class _Membership:
     ``endpoint`` is the coordinator address this host would serve if it
     became rank 0 after a shrink (pre-allocated port, published so
     survivors re-elect deterministically: lowest surviving uid wins).
-    No consensus protocol: every survivor computes the same answer from
-    the same files, which is exactly the torchrun-agent re-rendezvous
-    contract expressed over a shared filesystem instead of a TCP store.
+    No consensus protocol — but also no synchronized decision: each
+    survivor polls independently, so two supervisors straddling the
+    staleness boundary can transiently compute different survivor sets.
+    ``supervise`` therefore commits a shrink only after two consistent
+    reads separated by a heartbeat interval (see the settle logic there);
+    that narrows, not closes, the window — same contract as a torchrun
+    agent round that a slow host can still miss.
+
+    Staleness is judged in the SHARED FILESYSTEM's clock domain, not the
+    hosts': a peer is stale when our own heartbeat file's ``st_mtime``
+    (freshly beaten) exceeds the peer's by ``peer_timeout_s``. Both
+    mtimes are stamped by the same FS server at ``os.replace`` time, so
+    cross-host wall-clock skew — which could otherwise make a skewed
+    supervisor declare every live peer dead and split-brain the
+    checkpoint dir — cancels out. The embedded ``ts`` stays in the JSON
+    for humans/debugging only.
     """
 
     def __init__(self, run_dir: str, uid: int, endpoint: str):
@@ -105,16 +119,33 @@ class _Membership:
         self.path = os.path.join(self.dir, f"host_{uid}.json")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # Both the daemon heartbeat thread and survivors() (supervise
+        # thread) call beat(); serialise them so the shared tmp file can't
+        # interleave two writers and publish torn JSON.
+        self._beat_lock = threading.Lock()
 
     def beat(self) -> None:
-        os.makedirs(self.dir, exist_ok=True)
-        tmp = self.path + ".tmp"
-        with open(tmp, "w") as fh:
-            json.dump(
-                {"uid": self.uid, "endpoint": self.endpoint, "ts": time.time()},
-                fh,
-            )
-        os.replace(tmp, self.path)  # atomic: readers never see a torn write
+        with self._beat_lock:
+            if self._stop.is_set():
+                # retire() may have already unlinked the file; a straggler
+                # beat (e.g. a grow watcher blocked in survivors() past
+                # its join timeout) must not resurrect a heartbeat for a
+                # departed host — peers would count it alive for a full
+                # peer_timeout_s and could preempt healthy children over
+                # it.
+                return
+            os.makedirs(self.dir, exist_ok=True)
+            tmp = self.path + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump(
+                    {
+                        "uid": self.uid,
+                        "endpoint": self.endpoint,
+                        "ts": time.time(),
+                    },
+                    fh,
+                )
+            os.replace(tmp, self.path)  # atomic: no torn reads
 
     def start(self, interval_s: float) -> None:
         self.beat()
@@ -146,40 +177,92 @@ class _Membership:
         """Clean-exit path: withdraw from membership so peers don't wait
         out the staleness window on a host that finished its work."""
         self.stop()
-        try:
-            os.remove(self.path)
-        except OSError:
-            pass
+        # Unlink under the beat lock: any in-flight beat() finishes first,
+        # and every later one no-ops on the _stop check — the removal is
+        # final.
+        with self._beat_lock:
+            try:
+                os.remove(self.path)
+            except OSError:
+                pass
 
-    def survivors(self, peer_timeout_s: float) -> list[dict]:
+    def survivors(self, peer_timeout_s: float) -> Optional[list[dict]]:
         """Hosts with a fresh heartbeat, sorted by uid (self always
-        qualifies — the daemon thread is beating)."""
-        now = time.time()
+        qualifies — we beat right here before judging anyone).
+
+        Returns ``None`` when liveness CANNOT be judged this poll (no
+        FS-clock reference, or a peer's heartbeat file errored on read):
+        a partial shared-FS outage must defer the shrink decision
+        entirely, not silently drop live peers into the "dead" set and
+        split-brain the checkpoint dir.
+        """
+        # Re-beat so our own file's st_mtime is "now" in the FS clock
+        # domain; every peer mtime is then compared against it (see class
+        # docstring — never against local time.time()).
+        try:
+            self.beat()
+            now = os.stat(self.path).st_mtime
+        except OSError as e:
+            get_logger().warning(
+                "elastic: cannot stat own heartbeat (%s); "
+                "deferring liveness judgment this poll", e
+            )
+            return None
         out = []
         try:
             names = os.listdir(self.dir)
-        except OSError:
-            names = []
+        except OSError as e:
+            get_logger().warning(
+                "elastic: cannot list members dir (%s); deferring", e
+            )
+            return None
         for name in names:
             if not (name.startswith("host_") and name.endswith(".json")):
                 continue
+            path = os.path.join(self.dir, name)
             try:
-                with open(os.path.join(self.dir, name)) as fh:
+                mtime = os.stat(path).st_mtime
+            except FileNotFoundError:
+                continue  # just-deleted (clean retire): absent is correct
+            except OSError as e:
+                # EIO and friends: WE can't read, which says nothing
+                # about the peer — defer, same policy as the unreadable-
+                # fresh-heartbeat branch below.
+                get_logger().warning(
+                    "elastic: cannot stat %s (%s); deferring liveness "
+                    "judgment this poll", name, e
+                )
+                return None
+            if now - mtime > peer_timeout_s:
+                continue  # genuinely stale: dead
+            try:
+                with open(path) as fh:
                     rec = json.load(fh)
-            except (OSError, ValueError):
-                continue  # torn/just-deleted file: treat as absent this poll
-            if now - rec.get("ts", 0) <= peer_timeout_s:
-                out.append(rec)
+            except (OSError, ValueError) as e:
+                # A FRESH heartbeat we cannot read is an US problem
+                # (EIO, torn write), not evidence of a dead peer — refuse
+                # to judge rather than shrink a live host out.
+                get_logger().warning(
+                    "elastic: fresh heartbeat %s unreadable (%s); "
+                    "deferring liveness judgment this poll", name, e
+                )
+                return None
+            out.append(rec)
         return sorted(out, key=lambda r: r["uid"])
 
 
-def _own_endpoint(args) -> str:
+def _own_endpoint(args) -> tuple[str, Optional[socket.socket]]:
     """The coordinator address this host would serve after taking rank 0.
 
     Host reachable-address resolution: ``FRL_TPU_HOST_ADDRESS`` env (tests
     and multi-NIC deployments), else the current coordinator's host when we
-    already are rank 0, else this host's name. The port is freshly bound
-    then released — standard pre-allocation racy-but-practical pattern.
+    already are rank 0, else this host's name. Returns ``(endpoint,
+    held_socket)``: the pre-allocated port's socket stays OPEN (bound, not
+    listening) so nothing else on this host can take it during the
+    possibly-hours between startup and a shrink electing us rank 0;
+    ``supervise`` closes it immediately before launching the child that
+    will actually serve the coordinator there. The race window is thus the
+    few ms of child exec, not the supervisor's whole lifetime.
     """
     host = os.environ.get("FRL_TPU_HOST_ADDRESS")
     if host is None:
@@ -188,12 +271,17 @@ def _own_endpoint(args) -> str:
         else:
             host = socket.gethostname()
     if args.process_id in (0, None) and args.coordinator:
-        # Already the coordinator: keep serving the address peers know.
-        return args.coordinator
-    with socket.socket() as s:
+        # Already the coordinator: keep serving the address peers know
+        # (that port is the live child's to bind, not ours to hold).
+        return args.coordinator, None
+    s = socket.socket()
+    try:
         s.bind((host, 0))
-        port = s.getsockname()[1]
-    return f"{host}:{port}"
+    except OSError:
+        s.close()  # don't leak the fd on unresolvable host / bind failure
+        raise
+    port = s.getsockname()[1]
+    return f"{host}:{port}", s
 
 
 def supervise(args, cfg: ExperimentConfig) -> int:
@@ -213,9 +301,18 @@ def supervise(args, cfg: ExperimentConfig) -> int:
     the new topology. The child's fresh ``initialize`` + Orbax resharding
     restore (checkpoint/manager.py) do the actual continuation; data
     sharding re-splits because per-host slicing keys off the new
-    process_count. A host that comes back after a shrink fails its stale
-    rendezvous and must be re-admitted by operator action — same contract
-    as a torchrun agent that missed the re-rendezvous round.
+    process_count.
+
+    Grow-back (``elastic.grow``, on by default): after a shrink, a watcher
+    thread keeps reading the membership heartbeats while the child runs;
+    when an evicted host resumes beating (repaired, or a false-positive
+    eviction) for two consecutive polls, the watcher SIGTERMs the child —
+    which checkpoints and exits cleanly via the preemption path
+    (trainer/loop.py) — and the supervisor re-forms at the larger world
+    over the settled survivor set. The revived host needs no special
+    action: its own supervisor keeps relaunching the original topology,
+    whose rendezvous starts succeeding the moment the re-formed world
+    includes it.
     """
     logger = get_logger()
     env = os.environ.copy()
@@ -225,6 +322,11 @@ def supervise(args, cfg: ExperimentConfig) -> int:
     uid = args.process_id
     topo: dict = {}
     membership: Optional[_Membership] = None
+    held_port: Optional[socket.socket] = None
+    # One formula, used by the daemon beat rate AND the settle/watch
+    # windows — the "two reads one heartbeat interval apart" argument
+    # depends on them staying equal.
+    heartbeat_interval = max(0.5, cfg.elastic.peer_timeout_s / 4)
     if cfg.elastic.shrink_after > 0 and world > 1:
         if uid is None:
             # JAX-autodetected process ids (Cloud TPU metadata) are not
@@ -239,22 +341,165 @@ def supervise(args, cfg: ExperimentConfig) -> int:
                 cfg.elastic.shrink_after,
             )
         else:
+            endpoint, held_port = _own_endpoint(args)
             membership = _Membership(
-                os.path.join(cfg.workdir, cfg.name), uid, _own_endpoint(args)
+                os.path.join(cfg.workdir, cfg.name), uid, endpoint
             )
-            membership.start(
-                interval_s=max(0.5, cfg.elastic.peer_timeout_s / 4)
-            )
+            membership.start(interval_s=heartbeat_interval)
 
+    initial_world = world
     restarts = 0
     consecutive_failures = 0
+    #: Budget-free restarts granted after a grow commit: a partially
+    #: repaired cluster (some of the dead hosts back) re-forms in stages —
+    #: the revived host must shrink its ORIGINAL topology down to the
+    #: committed one via its own shrink logic, which costs it
+    #: shrink_after failed rendezvous first. The survivors' rendezvous
+    #: failures during that window are self-inflicted by the grow, not
+    #: child faults, and must not burn the real restart budget (else a
+    #: healthy shrunken run can die because a repair showed up).
+    grow_grace = 0
+
+    def settled_survivors() -> Optional[list[dict]]:
+        """Two identical survivor reads one heartbeat interval apart, or
+        None: supervisors poll unsynchronized, so one read taken at the
+        staleness boundary can disagree with a peer's — never commit a
+        topology change off a single poll."""
+        surv = membership.survivors(cfg.elastic.peer_timeout_s)
+        time.sleep(heartbeat_interval)
+        surv2 = membership.survivors(cfg.elastic.peer_timeout_s)
+        if surv is None or surv2 is None:
+            logger.warning(
+                "elastic: liveness unjudgeable (shared-FS error); "
+                "deferring topology decision"
+            )
+            return None
+        if [r["uid"] for r in surv] != [r["uid"] for r in surv2]:
+            logger.warning(
+                "elastic: survivor set unsettled (%s vs %s); deferring "
+                "topology decision",
+                [r["uid"] for r in surv],
+                [r["uid"] for r in surv2],
+            )
+            return None
+        return surv
+
+    def commit_reform(surv: list[dict], reason: str) -> None:
+        """Adopt the settled survivor set as the new world (shrink or
+        grow): ranks remapped by uid order, coordinator re-elected to the
+        lowest surviving uid's published endpoint, budgets refreshed."""
+        nonlocal world, topo, cmd, restarts, consecutive_failures, \
+            held_port, grow_grace
+        uids = [r["uid"] for r in surv]
+        new_world = len(surv)
+        new_rank = uids.index(uid)
+        new_coord = surv[0]["endpoint"] if new_world > 1 else None
+        logger.warning(
+            "elastic: %s from %d to %d processes; new rank=%d "
+            "coordinator=%s — resuming from last checkpoint with "
+            "resharding restore",
+            reason, world, new_world, new_rank, new_coord,
+        )
+        world = new_world
+        topo = {
+            "num_processes": new_world,
+            "process_id": new_rank,
+            "coordinator": new_coord,
+        }
+        if new_rank == 0 and new_world > 1 and held_port is not None:
+            # The child will bind the coordinator port we've been holding
+            # since startup; release it only now (race window = child
+            # exec, not supervisor life).
+            held_port.close()
+            held_port = None
+        cmd = _child_command(args, topo)
+        restarts = 0
+        consecutive_failures = 0
+        grow_grace = 3 if reason == "growing" else 0
+
+    def grow_watch(proc: subprocess.Popen, stop: threading.Event,
+                   grow_req: threading.Event) -> None:
+        """Post-shrink watcher: when the settled survivor set outgrows the
+        current world (an evicted host resumed beating), preempt the child
+        (SIGTERM -> checkpoint -> clean exit) so the main loop can re-form
+        at the larger world."""
+        consecutive = 0
+        while not stop.wait(heartbeat_interval):
+            surv = membership.survivors(cfg.elastic.peer_timeout_s)
+            if (
+                surv is not None
+                and uid in [r["uid"] for r in surv]
+                and world < len(surv) <= initial_world
+            ):
+                consecutive += 1
+                if consecutive >= 2:
+                    logger.warning(
+                        "elastic: evicted peer(s) heartbeating again "
+                        "(%d survivors > world %d); preempting child to "
+                        "re-form at the larger world",
+                        len(surv), world,
+                    )
+                    grow_req.set()
+                    proc.terminate()
+                    return
+            else:
+                consecutive = 0
+
     try:
         cmd = _child_command(args)
         logger.info("elastic: supervising %s", " ".join(cmd))
         while True:
             t0 = time.monotonic()
-            rc = subprocess.call(cmd, cwd=_REPO_ROOT, env=env)
+            proc = subprocess.Popen(cmd, cwd=_REPO_ROOT, env=env)
+            grow_req = threading.Event()
+            stop_watch = threading.Event()
+            watcher: Optional[threading.Thread] = None
+            if (
+                membership is not None
+                and cfg.elastic.grow
+                and world < initial_world
+            ):
+                watcher = threading.Thread(
+                    target=grow_watch,
+                    args=(proc, stop_watch, grow_req),
+                    name="elastic-grow-watch",
+                    daemon=True,
+                )
+                watcher.start()
+            rc = proc.wait()
+            stop_watch.set()
+            if watcher is not None:
+                watcher.join(timeout=5)
             elapsed = time.monotonic() - t0
+
+            if grow_req.is_set():
+                surv = settled_survivors()
+                if (
+                    surv is not None
+                    and uid in [r["uid"] for r in surv]
+                    and world < len(surv) <= initial_world
+                ):
+                    commit_reform(surv, "growing")
+                    continue
+                # Fizzled grow. Budget-free relaunch ONLY when the exit
+                # really was our preemption — clean exit via the
+                # preemption path (rc 0) or killed by our SIGTERM
+                # mid-init (rc -15). A child that died of a genuine
+                # fault (rc 1, OOM, ...) in the same interval the
+                # watcher fired must fall through to normal failure
+                # accounting, or a crash-looping child + flapping peer
+                # relaunches forever with no backoff and no budget.
+                if rc == 0 or rc == -signal.SIGTERM:
+                    logger.warning(
+                        "elastic: grow fizzled (peer gone again?); "
+                        "continuing at world=%d", world
+                    )
+                    continue
+                logger.warning(
+                    "elastic: grow fizzled AND child died on its own "
+                    "(rc=%d); counting the failure", rc
+                )
+
             if rc == 0:
                 logger.info(
                     "elastic: run completed after %d restart(s)", restarts
@@ -270,33 +515,27 @@ def supervise(args, cfg: ExperimentConfig) -> int:
                 and world > 1
                 and consecutive_failures >= cfg.elastic.shrink_after
             ):
-                surv = membership.survivors(cfg.elastic.peer_timeout_s)
-                uids = [r["uid"] for r in surv]
-                if uid in uids and len(surv) < world:
-                    new_world = len(surv)
-                    new_rank = uids.index(uid)
-                    new_coord = surv[0]["endpoint"] if new_world > 1 else None
+                surv = settled_survivors()
+                if (
+                    surv is not None
+                    and uid in [r["uid"] for r in surv]
+                    and len(surv) < world
+                ):
                     logger.warning(
-                        "elastic: shrinking from %d to %d processes "
-                        "(dead peers stale > %.0fs); new rank=%d "
-                        "coordinator=%s — resuming from last checkpoint "
-                        "with resharding restore",
-                        world,
-                        new_world,
+                        "elastic: dead peers stale > %.0fs",
                         cfg.elastic.peer_timeout_s,
-                        new_rank,
-                        new_coord,
                     )
-                    world = new_world
-                    topo = {
-                        "num_processes": new_world,
-                        "process_id": new_rank,
-                        "coordinator": new_coord,
-                    }
-                    cmd = _child_command(args, topo)
-                    restarts = 0  # fresh budget for the new topology
-                    consecutive_failures = 0
+                    commit_reform(surv, "shrinking")
                     continue  # relaunch immediately — peers already waited
+
+            if grow_grace > 0:
+                grow_grace -= 1
+                logger.warning(
+                    "elastic: child rc=%d during grow re-formation; "
+                    "budget-free retry (%d grace left)", rc, grow_grace
+                )
+                time.sleep(cfg.elastic.backoff_s)
+                continue
 
             if restarts >= cfg.elastic.max_restarts:
                 logger.error(
@@ -319,6 +558,8 @@ def supervise(args, cfg: ExperimentConfig) -> int:
             )
             time.sleep(delay)
     finally:
+        if held_port is not None:
+            held_port.close()
         if membership is not None:
             membership.retire()
 
@@ -338,17 +579,24 @@ def fault_hook_from_env(
     workdir makes the fault one-shot so the restarted child survives even
     when it resumes from a checkpoint before the fault step.
     """
+    delay_s = float(os.environ.get("FRL_STEP_DELAY_S", "0") or 0)
     spec = os.environ.get("FRL_FAULT_AT_STEP")
-    if not spec:
-        return None
-    fault_step = int(spec)
+    fault_step = int(spec) if spec else 0
     marker = os.path.join(cfg.workdir, cfg.name, "fault_injected")
-    if os.path.exists(marker):
+    if fault_step and os.path.exists(marker):
+        fault_step = 0
+    if not fault_step and not delay_s:
         return None
     logger = get_logger()
 
     def hook(step: int, metrics: dict) -> None:
-        if step + 1 == fault_step:
+        if delay_s:
+            # Chaos/elasticity drills: stretch wall-clock per step so
+            # supervisor-side events (peer revival, preemption) can land
+            # while the child is mid-run. Synthetic steps are sub-ms;
+            # without this the run is over before any drill fires.
+            time.sleep(delay_s)
+        if fault_step and step + 1 == fault_step:
             os.makedirs(os.path.dirname(marker), exist_ok=True)
             with open(marker, "w") as fh:
                 fh.write(str(fault_step))

@@ -90,6 +90,10 @@ class MoEMlp(nn.Module):
         d = cfg.hidden_dim
         hidden = d * cfg.mlp_ratio
         e, k = moe.num_experts, moe.top_k
+        if moe.dispatch not in ("einsum", "sort"):
+            raise ValueError(
+                f"moe.dispatch={moe.dispatch!r}: expected 'einsum' or 'sort'"
+            )
         b, t, _ = x.shape
         n = b * t
         g = _num_groups(moe, n, b, train)
@@ -110,44 +114,109 @@ class MoEMlp(nn.Module):
 
         # Position-in-expert via per-group cumulative counts, slot by slot
         # (slot-major: every token's first choice is seated before any
-        # second choice, per GShard).
-        dispatch = jnp.zeros((g, s, e, capacity), self.dtype)
-        combine = jnp.zeros((g, s, e, capacity), jnp.float32)
+        # second choice, per GShard). The seating is SHARED by both
+        # dispatch formulations, so routing/drop semantics are identical
+        # and `test_moe_sorted_matches_einsum` can pin exact equivalence.
+        pos_toks, keeps = [], []
         prev_counts = jnp.zeros((g, e), jnp.int32)
         for slot in range(k):
             onehot = jax.nn.one_hot(gate_idx[..., slot], e, dtype=jnp.int32)
             pos = jnp.cumsum(onehot, axis=1) - 1 + prev_counts[:, None, :]
             prev_counts = prev_counts + onehot.sum(axis=1)
             pos_tok = (pos * onehot).sum(-1)  # (G, S)
-            keep = pos_tok < capacity
-            pos_oh = jax.nn.one_hot(pos_tok, capacity, dtype=self.dtype)
-            slot_dispatch = (
-                onehot.astype(self.dtype)[..., None]
-                * pos_oh[..., None, :]
-                * keep.astype(self.dtype)[..., None, None]
-            )
-            dispatch = dispatch + slot_dispatch
-            combine = combine + slot_dispatch.astype(jnp.float32) * gate_vals[
-                ..., slot
-            ].astype(jnp.float32)[..., None, None]
+            pos_toks.append(pos_tok)
+            keeps.append(pos_tok < capacity)
 
         # Expert computation: stacked params, expert axis shardable. The
-        # group dim rides the batch sharding; the E dim the expert axis —
-        # GSPMD turns the dispatch/combine einsums into all_to_all on ICI.
+        # group dim rides the batch sharding; the E dim the expert axis.
         wi = self.param(
             "wi", nn.initializers.normal(stddev=0.02), (e, d, hidden)
         )
         wo = self.param(
             "wo", nn.initializers.normal(stddev=0.02), (e, hidden, d)
         )
-        expert_in = jnp.einsum("gsec,gsd->egcd", dispatch, xf)  # all_to_all
-        h = jax.nn.gelu(
-            jnp.einsum("egcd,edh->egch", expert_in, wi.astype(self.dtype))
-        )
-        expert_out = jnp.einsum("egch,ehd->egcd", h, wo.astype(self.dtype))
-        y = jnp.einsum(
-            "gsec,egcd->gsd", combine.astype(self.dtype), expert_out
-        )  # and back
+
+        if moe.dispatch == "sort":
+            # Ragged (scatter/gather) exchange: seat indices scattered
+            # into the [E*C] slot table, tokens gathered by index —
+            # ~zero exchange MACs vs the einsum pair's O(S*E*C*D), which
+            # at audited shapes costs as much as the expert FFN itself
+            # (docs/perf_playbook.md "Dispatch FLOPs"). Sentinel row s /
+            # slot e*c catches drops and empty seats (gathered as zeros,
+            # scattered into the void via mode='drop').
+            gi = jnp.arange(g)[:, None]
+            token_idx = jnp.broadcast_to(jnp.arange(s)[None, :], (g, s))
+            src = jnp.full((g, e * capacity), s, jnp.int32)
+            for slot in range(k):
+                dest = jnp.where(
+                    keeps[slot],
+                    gate_idx[..., slot] * capacity + pos_toks[slot],
+                    e * capacity,
+                )
+                src = src.at[gi, dest].set(token_idx, mode="drop")
+            x_pad = jnp.concatenate(
+                [xf, jnp.zeros((g, 1, d), self.dtype)], axis=1
+            )
+            expert_in = (
+                x_pad[gi, src]  # (G, E*C, D)
+                .reshape(g, e, capacity, d)
+                .transpose(1, 0, 2, 3)  # (E, G, C, D)
+            )
+            h = jax.nn.gelu(
+                jnp.einsum("egcd,edh->egch", expert_in, wi.astype(self.dtype))
+            )
+            expert_out = jnp.einsum("egch,ehd->egcd", h, wo.astype(self.dtype))
+            out_pad = jnp.concatenate(
+                [
+                    expert_out.transpose(1, 0, 2, 3).reshape(
+                        g, e * capacity, d
+                    ),
+                    jnp.zeros((g, 1, d), self.dtype),
+                ],
+                axis=1,
+            )
+            y = jnp.zeros((g, s, d), self.dtype)
+            for slot in range(k):
+                idx = jnp.where(
+                    keeps[slot],
+                    gate_idx[..., slot] * capacity + pos_toks[slot],
+                    e * capacity,
+                )
+                w = jnp.where(
+                    keeps[slot], gate_vals[..., slot], 0.0
+                ).astype(self.dtype)
+                y = y + out_pad[gi, idx] * w[..., None]
+        else:
+            # One-hot einsum exchange (GShard): GSPMD turns the
+            # dispatch/combine einsums into all_to_all on ICI.
+            dispatch = jnp.zeros((g, s, e, capacity), self.dtype)
+            combine = jnp.zeros((g, s, e, capacity), jnp.float32)
+            for slot in range(k):
+                onehot = jax.nn.one_hot(
+                    gate_idx[..., slot], e, dtype=jnp.int32
+                )
+                pos_oh = jax.nn.one_hot(
+                    pos_toks[slot], capacity, dtype=self.dtype
+                )
+                slot_dispatch = (
+                    onehot.astype(self.dtype)[..., None]
+                    * pos_oh[..., None, :]
+                    * keeps[slot].astype(self.dtype)[..., None, None]
+                )
+                dispatch = dispatch + slot_dispatch
+                combine = combine + slot_dispatch.astype(
+                    jnp.float32
+                ) * gate_vals[..., slot].astype(jnp.float32)[..., None, None]
+            expert_in = jnp.einsum("gsec,gsd->egcd", dispatch, xf)
+            h = jax.nn.gelu(
+                jnp.einsum("egcd,edh->egch", expert_in, wi.astype(self.dtype))
+            )
+            expert_out = jnp.einsum(
+                "egch,ehd->egcd", h, wo.astype(self.dtype)
+            )
+            y = jnp.einsum(
+                "gsec,egcd->gsd", combine.astype(self.dtype), expert_out
+            )  # and back
 
         # GShard load-balance loss, E * sum_e(frac_tokens_e * mean_prob_e),
         # with frac counting ALL k assignment slots (each slot contributes

@@ -1,0 +1,94 @@
+"""Per-process supervisor half of the grow-back (re-admission) test.
+
+Launched (once per simulated host) by tests/test_elastic_multiprocess.py::
+test_multiprocess_grow_back_after_shrink. Host 0 (the COORDINATOR) dies
+(fault + zero restart budget), host 1 shrinks to a 1-process world and
+keeps training — then host 0 COMES BACK (the repaired-host scenario the
+round-4 supervisor left to operator action): its script waits until the
+shrunken world has visibly progressed (a checkpoint ≥ GATE_STEP), then
+starts a fresh supervisor with the ORIGINAL topology. Host 1's grow
+watcher must notice the revived heartbeat, preempt its child (SIGTERM →
+checkpoint → clean exit), and re-form the 2-process world; both finish
+the run together with no step lost or duplicated.
+
+Env contract: FRL_TPU_COORDINATOR, FRL_TPU_NUM_PROCESSES,
+FRL_TPU_PROCESS_ID, FRL_TEST_WORKDIR; FRL_FAULT_AT_STEP on host 0 only;
+FRL_STEP_DELAY_S stretches step wall-clock so the revival lands mid-run;
+FRL_TPU_INIT_TIMEOUT_S bounds rendezvous waits; FRL_TPU_HOST_ADDRESS
+pins published endpoints to loopback.
+"""
+
+import os
+import sys
+import time
+
+#: The shrunken world must have saved a checkpoint at/after this step
+#: before host 0 revives (proves the 1-process continuation made real
+#: progress first — and leaves plenty of run for the grown world).
+GATE_STEP = 15
+
+
+def _launch(extra):
+    from frl_distributed_ml_scaffold_tpu.launcher.launch import main as launch_main
+
+    return launch_main(
+        [
+            "--config", "mnist_mlp",
+            "--device", "cpu",
+            "--sim-devices", "2",
+            "--coordinator", os.environ["FRL_TPU_COORDINATOR"],
+            "--num-processes", os.environ["FRL_TPU_NUM_PROCESSES"],
+            "--process-id", os.environ["FRL_TPU_PROCESS_ID"],
+            "--elastic",
+            "trainer.total_steps=120",
+            "trainer.log_every=10",
+            "trainer.eval_every=0",
+            "data.global_batch_size=64",
+            "data.prefetch=0",
+            "model.hidden_sizes=32",
+            "precision.policy=fp32",
+            "checkpoint.save_every=5",
+            "checkpoint.async_save=false",
+            "elastic.backoff_s=0.1",
+            "elastic.shrink_after=2",
+            "elastic.peer_timeout_s=6",
+            "workdir=" + os.environ["FRL_TEST_WORKDIR"],
+        ]
+        + extra
+    )
+
+
+def main() -> int:
+    pid = os.environ["FRL_TPU_PROCESS_ID"]
+    if pid != "0":
+        return _launch([])
+
+    # Host 0, act 1: the doomed coordinator (fault at step 9, no budget).
+    rc = _launch(["elastic.max_restarts=0"])
+    assert rc == 43, f"expected the injected fault's rc, got {rc}"
+
+    # Act 2: wait for the survivor to shrink and progress past the gate...
+    ckpt_dir = os.path.join(
+        os.environ["FRL_TEST_WORKDIR"], "mnist_mlp", "ckpt"
+    )
+    deadline = time.monotonic() + 150
+    while time.monotonic() < deadline:
+        steps = [
+            int(d) for d in (
+                os.listdir(ckpt_dir) if os.path.isdir(ckpt_dir) else []
+            ) if d.isdigit()
+        ]
+        if steps and max(steps) >= GATE_STEP:
+            break
+        time.sleep(0.5)
+    else:
+        print("grow worker: survivor never progressed past the gate")
+        return 7
+
+    # ...then come back from repair: fresh supervisor, ORIGINAL topology.
+    # (The fault marker already exists, so the fault hook stays disarmed.)
+    return _launch(["elastic.max_restarts=8"])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

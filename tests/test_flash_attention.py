@@ -93,6 +93,27 @@ def test_fallback_on_untileable_shapes():
     np.testing.assert_allclose(out, ref, atol=1e-6)
 
 
+def test_length_adaptive_block_ladder():
+    """Pin the auto block selection the on-chip sweep tuned
+    (evidence_r4/flash_sweep.log → BASELINE.md long-context table):
+    512 below 16k, 1024 from 16k up — at 16k/32k/64k the 1024×1024
+    blocks measured +21%/+37%/+39% over 512×512 on v5e. A regression
+    here silently costs a third of long-context throughput."""
+    import importlib
+
+    fa_mod = importlib.import_module(
+        "frl_distributed_ml_scaffold_tpu.ops.flash_attention"
+    )
+    for t, want in [
+        (1024, 512), (8192, 512),
+        (16384, 1024), (32768, 1024), (65536, 1024),
+    ]:
+        assert fa_mod._auto_block(t) == want, (t, fa_mod._auto_block(t))
+        # And the tileability snap keeps the preferred size whole at
+        # power-of-two T (these lengths never fall down the ladder).
+        assert fa_mod._pick_block(t, want) == want
+
+
 def test_sharded_flash_matches_dense():
     """Under a live mesh the wrapper runs the kernel inside shard_map over
     the batch + TP-head axes — per-(b,h) local, no gather (the review-flagged

@@ -581,6 +581,81 @@ def test_moe_grouped_dispatch_matches_ungrouped():
     np.testing.assert_allclose(float(aux1), float(aux4), rtol=1e-6)
 
 
+def test_moe_sorted_matches_einsum():
+    """moe.dispatch=sort (scatter/gather ragged exchange) must be a pure
+    reformulation of the einsum-GSEC path: the seating cumsum is shared,
+    so outputs, aux, and drop behavior are identical — including under
+    tight capacity (real drops) and grouped routing. Gradients too: the
+    gather/scatter VJP must agree with the one-hot einsum VJP."""
+    import dataclasses
+
+    from frl_distributed_ml_scaffold_tpu.models.moe import MoEMlp
+
+    x = jax.random.normal(jax.random.key(0), (2, 16, 32), jnp.float32)
+
+    def run(cfg, with_grad=False):
+        m = MoEMlp(cfg, jnp.float32)
+        variables = jax.jit(
+            lambda v: m.init(jax.random.key(1), v, train=True)
+        )(x)
+
+        def loss_fn(v, xx):
+            y, aux = m.apply(v, xx, train=True)
+            return jnp.sum(y * y) + aux, (y, aux)
+
+        if with_grad:
+            (loss, (y, aux)), grads = jax.jit(
+                jax.value_and_grad(loss_fn, has_aux=True)
+            )(variables, x)
+            return y, aux, grads
+        (_, (y, aux)) = jax.jit(loss_fn)(variables, x)
+        return y, aux, None
+
+    for label, moe_cfg in [
+        ("ample", MoEConfig(num_experts=4, top_k=2, capacity_factor=8.0)),
+        ("drops", MoEConfig(num_experts=4, top_k=2, capacity_factor=0.5)),
+        (
+            "grouped",
+            MoEConfig(
+                num_experts=4, top_k=2, capacity_factor=1.25, num_groups=2
+            ),
+        ),
+    ]:
+        cfg_e = tiny_gpt(moe=moe_cfg)
+        cfg_s = dataclasses.replace(
+            cfg_e, moe=dataclasses.replace(moe_cfg, dispatch="sort")
+        )
+        with_grad = label == "drops"
+        y_e, aux_e, g_e = run(cfg_e, with_grad)
+        y_s, aux_s, g_s = run(cfg_s, with_grad)
+        np.testing.assert_allclose(
+            y_e, y_s, atol=1e-5, rtol=1e-5, err_msg=label
+        )
+        np.testing.assert_allclose(
+            float(aux_e), float(aux_s), rtol=1e-6, err_msg=label
+        )
+        if with_grad:
+            jax.tree.map(
+                lambda a, b: np.testing.assert_allclose(
+                    a, b, atol=1e-4, rtol=1e-4, err_msg=label
+                ),
+                g_e,
+                g_s,
+            )
+
+
+def test_moe_sort_dispatch_rejects_unknown():
+    from frl_distributed_ml_scaffold_tpu.models.moe import MoEMlp
+
+    cfg = tiny_gpt(
+        moe=MoEConfig(num_experts=4, top_k=2, dispatch="ragged")
+    )
+    m = MoEMlp(cfg, jnp.float32)
+    x = jax.random.normal(jax.random.key(0), (2, 16, 32), jnp.float32)
+    with pytest.raises(ValueError, match="dispatch"):
+        m.init(jax.random.key(1), x, train=False)
+
+
 def test_moe_router_z_loss_penalizes_large_logits():
     """The z-loss term must grow with router-logit magnitude (its whole
     point) and vanish when disabled."""

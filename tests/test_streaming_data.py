@@ -1,0 +1,156 @@
+"""Online-ingestion tier (SURVEY C16, VERDICT r4 missing #5): the loader
+widens its sampling window while producers keep sealing new shards —
+reference parity with torch's streaming DataLoader, expressed as an
+append-only shard watermark (data/streaming.py)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from frl_distributed_ml_scaffold_tpu.config.schema import DataConfig
+from frl_distributed_ml_scaffold_tpu.data.imagenet import ImageNet
+from frl_distributed_ml_scaffold_tpu.data.shards import sealed_save
+from frl_distributed_ml_scaffold_tpu.data.streaming import (
+    StreamingShardCorpus,
+    _sealed_pair_count,
+)
+
+
+def _write_shard(dir_, idx, *, n=8, size=8, label_base=0, labels=True):
+    rng = np.random.default_rng(idx)
+    sealed_save(
+        os.path.join(dir_, f"train_images_{idx:03d}.npy"),
+        rng.random((n, size, size, 3), np.float32).astype(np.float32),
+    )
+    if labels:
+        sealed_save(
+            os.path.join(dir_, f"train_labels_{idx:03d}.npy"),
+            np.full(n, label_base + idx, np.int32),
+        )
+
+
+def test_sealed_pair_count_prefix_rule(tmp_path):
+    d = str(tmp_path)
+    assert _sealed_pair_count(d, "train", "images") == 0
+    _write_shard(d, 0)
+    _write_shard(d, 1, labels=False)  # labels half still in flight
+    _write_shard(d, 2)  # sealed, but AFTER the incomplete pair
+    # Prefix rule: the window stops at the first incomplete pair — shard 2
+    # stays invisible until shard 1's labels land (index order is the
+    # producers' append order).
+    assert _sealed_pair_count(d, "train", "images") == 1
+
+
+def test_streaming_refuses_empty_corpus(tmp_path):
+    """Zero sealed pairs must REFUSE, not fall back: an uncapped view can
+    crash on a half-sealed pair, and the loader's synthetic fallback is
+    decided once at construction — it would silently train on fake data
+    forever while real shards land seconds later."""
+    d = str(tmp_path)
+    with pytest.raises(ValueError, match="no sealed"):
+        StreamingShardCorpus(d, "train", "images", refresh_every=4)
+    # Half-sealed (labels in flight) is still "no pair".
+    _write_shard(d, 0, labels=False)
+    with pytest.raises(ValueError, match="no sealed"):
+        StreamingShardCorpus(d, "train", "images", refresh_every=4)
+
+
+def test_streaming_corpus_widens_and_freezes_between_refreshes(tmp_path):
+    d = str(tmp_path)
+    _write_shard(d, 0)
+    corpus = StreamingShardCorpus(d, "train", "images", refresh_every=10)
+    assert corpus.found and corpus.n == 8
+    assert corpus.state() == {"shards": 1, "items": 8}
+
+    _write_shard(d, 1)
+    # Before the refresh step the view is FROZEN (determinism contract).
+    corpus.maybe_refresh(5)
+    assert corpus.n == 8
+    # At/after the refresh boundary the window widens to the new shard.
+    corpus.maybe_refresh(10)
+    assert corpus.n == 16
+    assert corpus.state() == {"shards": 2, "items": 16}
+    # New items are actually reachable, with their own labels.
+    x, y = corpus.gather(np.arange(8, 16))
+    assert x.shape == (8, 8, 8, 3)
+    np.testing.assert_array_equal(y, np.full(8, 1))
+
+
+def test_streaming_multihost_window_protocol(tmp_path, monkeypatch):
+    """Leader-published window with deferred activation: hosts adopt the
+    same shard SET at the same refresh bucket — never a count-only,
+    moment-of-read-dependent min (the divergence mode a symmetric
+    protocol has). Two hosts simulated in one process by patching
+    process_count/index."""
+    import json
+
+    import jax
+
+    d = str(tmp_path)
+    _write_shard(d, 0)
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+
+    # Pre-seed host 1's publish (what its construction would write),
+    # then construct the leader — it proposes the initial window — and
+    # the follower, which adopts it. Sequential: the two "hosts" share
+    # one process here, so concurrency would also share the monkeypatch.
+    os.makedirs(os.path.join(d, ".stream_sync"), exist_ok=True)
+    with open(
+        os.path.join(d, ".stream_sync", "train_images_host_1.json"), "w"
+    ) as fh:
+        json.dump({"count": 1, "anchor": 0}, fh)
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    leader = StreamingShardCorpus(d, "train", "images", refresh_every=10)
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    follower = StreamingShardCorpus(d, "train", "images", refresh_every=10)
+    assert leader.n == follower.n == 8
+
+    # Producer seals shard 1. At bucket 1 both hosts publish their new
+    # counts; the leader (refreshing after the follower's publish is
+    # visible) PROPOSES with activation deferred to bucket 2 — neither
+    # adopts yet. Both adopt at their bucket-2 refresh; the window file
+    # carries anchor + count (a shard SET, not a bare count).
+    _write_shard(d, 1)
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    follower.maybe_refresh(10)  # publishes count=2; window still old
+    assert follower.n == 8
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    leader.maybe_refresh(10)  # bucket 1: proposes, must not adopt
+    assert leader.n == 8
+    win = json.load(
+        open(os.path.join(d, ".stream_sync", "train_images_window.json"))
+    )
+    assert win == {"count": 2, "anchor": 0, "activate_at_bucket": 2}
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    follower.maybe_refresh(20)  # bucket 2: adopt
+    assert follower.n == 16
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    leader.maybe_refresh(20)
+    assert leader.n == 16
+
+
+def test_streaming_loader_end_to_end(tmp_path):
+    d = str(tmp_path)
+    _write_shard(d, 0, n=16, size=8)
+    cfg = DataConfig(
+        name="imagenet", global_batch_size=4, image_size=8, channels=3,
+        num_classes=16, data_dir=d, streaming=True,
+        streaming_refresh_every=4, prefetch=0,
+    )
+    loader = ImageNet(cfg, split="train")
+    assert not loader.is_synthetic
+    b0 = loader.batch(0, 4)
+    assert b0["image"].shape == (4, 8, 8, 3)
+    assert set(np.unique(b0["label"])) <= {0}
+
+    _write_shard(d, 1, n=16, size=8)
+    # Steps before the refresh boundary still sample the old window...
+    for step in range(1, 4):
+        assert set(np.unique(loader.batch(step, 4)["label"])) <= {0}
+    # ...and from the boundary on, shard 1's labels appear (sample enough
+    # batches that missing them is a ~1e-10 event, not a flake).
+    seen = set()
+    for step in range(4, 40):
+        seen |= set(np.unique(loader.batch(step, 4)["label"]))
+    assert seen == {0, 1}, seen

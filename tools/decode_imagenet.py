@@ -33,6 +33,10 @@ import sys
 
 import numpy as np
 
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)  # repo root: the sealed-save helper lives in the package
+
 _IMAGE_EXTS = (".jpeg", ".jpg", ".png", ".bmp")
 
 
@@ -88,15 +92,24 @@ def main() -> int:
                     help="class-mixing shuffle of the file order")
     ap.add_argument("--limit", type=int, default=0,
                     help="stop after N images (0 = all; for smoke runs)")
+    ap.add_argument("--splits", default="",
+                    help="comma-separated split dirs whose class lists are "
+                         "unioned for label ids (default: conventional "
+                         "split names under raw_dir); pin this when "
+                         "raw_dir holds non-split directories")
     args = ap.parse_args()
 
-    split_dir = os.path.join(args.raw_dir, args.split)
-    classes = sorted(
-        d for d in os.listdir(split_dir)
-        if os.path.isdir(os.path.join(split_dir, d))
+    from frl_distributed_ml_scaffold_tpu.data.shards import (
+        derive_label_classes,
     )
-    if not classes:
-        print(f"no class directories under {split_dir}", file=sys.stderr)
+
+    split_dir = os.path.join(args.raw_dir, args.split)
+    try:
+        classes, _ = derive_label_classes(
+            args.raw_dir, args.split, args.splits, args.out_dir
+        )
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
         return 2
     pairs = []  # (path, label)
     skipped = 0
@@ -125,14 +138,19 @@ def main() -> int:
         nonlocal buf_x, buf_y, shard_idx
         if not buf_x:
             return
+        from frl_distributed_ml_scaffold_tpu.data.shards import sealed_save
+
         x = np.stack(buf_x)
-        np.save(
+        # Sealed (tmp+rename) writes, DATA before LABELS: the streaming
+        # tier treats the labels shard as the pair's commit marker, so a
+        # reader never samples a pair whose halves are mid-write.
+        sealed_save(
             os.path.join(
                 args.out_dir, f"{args.split}_images_{shard_idx:03d}.npy"
             ),
             x,
         )
-        np.save(
+        sealed_save(
             os.path.join(
                 args.out_dir, f"{args.split}_labels_{shard_idx:03d}.npy"
             ),

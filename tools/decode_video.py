@@ -43,6 +43,10 @@ import sys
 
 import numpy as np
 
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)  # repo root: the sealed-save helper lives in the package
+
 _FRAME_EXTS = (".jpeg", ".jpg", ".png", ".bmp")
 
 
@@ -145,15 +149,27 @@ def main() -> int:
     ap.add_argument("--dtype", default="uint8", choices=["uint8", "float32"])
     ap.add_argument("--limit", type=int, default=0,
                     help="stop after N clips (0 = all; for smoke runs)")
+    ap.add_argument("--splits", default="",
+                    help="comma-separated split dirs whose class lists are "
+                         "unioned for label ids (default: every "
+                         "subdirectory of raw_dir); pin this when raw_dir "
+                         "holds non-split directories")
     args = ap.parse_args()
 
-    split_dir = os.path.join(args.raw_dir, args.split)
-    classes = sorted(
-        d for d in os.listdir(split_dir)
-        if os.path.isdir(os.path.join(split_dir, d))
+    from frl_distributed_ml_scaffold_tpu.data.shards import (
+        derive_label_classes,
     )
-    if not classes:
-        print(f"no class directories under {split_dir}", file=sys.stderr)
+
+    split_dir = os.path.join(args.raw_dir, args.split)
+    # Label ids must agree ACROSS splits — union class list over the
+    # split set + cross-check against any earlier split's meta (one
+    # implementation for both producers: data/shards.py).
+    try:
+        classes, split_names = derive_label_classes(
+            args.raw_dir, args.split, args.splits, args.out_dir
+        )
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
         return 2
 
     span = args.frames * args.frame_stride
@@ -165,13 +181,17 @@ def main() -> int:
         nonlocal buf_x, buf_y, shard_idx
         if not buf_x:
             return
-        np.save(
+        from frl_distributed_ml_scaffold_tpu.data.shards import sealed_save
+
+        # Sealed (tmp+rename) writes, DATA before LABELS — the streaming
+        # tier's pair-commit contract (data/streaming.py).
+        sealed_save(
             os.path.join(
                 args.out_dir, f"{args.split}_clips_{shard_idx:03d}.npy"
             ),
             np.stack(buf_x),
         )
-        np.save(
+        sealed_save(
             os.path.join(
                 args.out_dir, f"{args.split}_labels_{shard_idx:03d}.npy"
             ),
@@ -211,7 +231,7 @@ def main() -> int:
         "classes": len(classes), "frames": args.frames,
         "frame_stride": args.frame_stride, "clip_stride": hop,
         "size": args.size, "dtype": args.dtype, "shards": shard_idx,
-        "class_names": classes,
+        "class_names": classes, "label_splits": split_names,
     }
     with open(
         os.path.join(args.out_dir, f"{args.split}_meta.json"), "w"
