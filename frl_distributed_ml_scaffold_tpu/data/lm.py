@@ -50,7 +50,12 @@ def write_token_bin(path: str, tokens, *, vocab_size: int | None = None) -> None
     hi = int(tokens.max()) if tokens.size else 0
     if vocab_size is not None and hi >= vocab_size:
         raise ValueError(f"token id {hi} out of range for vocab_size {vocab_size}")
-    dtype = np.uint16 if hi < 2**16 else np.uint32
+    # Size the dtype from the VOCAB when declared, not the observed max:
+    # the sidecar pins the dtype forever (append_token_bin enforces it),
+    # and a first chunk that happened to stay under 65536 must not wedge
+    # a 100k-vocab stream on uint16.
+    limit = vocab_size - 1 if vocab_size is not None else hi
+    dtype = np.uint16 if limit < 2**16 else np.uint32
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     tokens.astype(dtype).tofile(path)
     sidecar = {"dtype": dtype.__name__}
@@ -66,6 +71,43 @@ def _read_sidecar(path: str) -> dict:
         with open(sidecar_path) as fh:
             return json.load(fh)
     return {}
+
+
+def append_token_bin(path: str, tokens) -> None:
+    """Streaming-producer append: grow an existing token bin in place.
+
+    The dtype is PINNED by the existing sidecar (``write_token_bin`` must
+    have created the file) — an appender that re-decided uint16 vs uint32
+    per chunk would corrupt the stream the moment a chunk's max id
+    crossed 65535. Appends are what the streaming loader
+    (data/streaming.py ``StreamingTokenBin``) consumes: it rounds the
+    visible window DOWN to a coarse token block, so a half-flushed tail
+    here is never sampled.
+    """
+    sidecar = _read_sidecar(path)
+    dtype = _BIN_DTYPES.get(sidecar.get("dtype"))
+    if dtype is None:
+        raise ValueError(
+            f"{path} has no sidecar dtype; create the bin with "
+            "write_token_bin first"
+        )
+    tokens = np.asarray(tokens)
+    if tokens.ndim != 1:
+        raise ValueError(f"token stream must be 1-D, got shape {tokens.shape}")
+    if tokens.size:
+        hi, lo = int(tokens.max()), int(tokens.min())
+        if lo < 0 or hi >= np.iinfo(dtype).max + 1:
+            raise ValueError(
+                f"token ids [{lo}, {hi}] do not fit the bin's pinned "
+                f"dtype {dtype.__name__}"
+            )
+        vocab = sidecar.get("vocab_size")
+        if vocab is not None and hi >= vocab:
+            raise ValueError(
+                f"token id {hi} out of range for vocab_size {vocab}"
+            )
+    with open(path, "ab") as fh:
+        tokens.astype(dtype).tofile(fh)
 
 
 class TokenBinLM:
@@ -105,6 +147,7 @@ class TokenBinLM:
                     split,
                 )
                 path = None
+        self._stream = None
         if path is not None:
             sidecar = _read_sidecar(path)
             dtype = _BIN_DTYPES.get(sidecar.get("dtype", "uint16"))
@@ -113,7 +156,37 @@ class TokenBinLM:
                     f"{path}.json names unsupported dtype "
                     f"{sidecar.get('dtype')!r}; expected uint16/uint32"
                 )
-            self._mm = np.memmap(path, dtype=dtype, mode="r")
+            if cfg.streaming and split == "train":
+                # Online ingestion: the producer keeps APPENDING to the
+                # bin (append_token_bin); the visible token window widens
+                # every streaming_refresh_every steps, host-agreed. Train
+                # split only — eval keeps the frozen view.
+                from frl_distributed_ml_scaffold_tpu.data.streaming import (
+                    StreamingTokenBin,
+                )
+
+                self._stream = StreamingTokenBin(
+                    path, dtype,
+                    refresh_every=cfg.streaming_refresh_every,
+                )
+                self._mm = self._stream.tokens
+            elif cfg.streaming:
+                # Non-train splits under streaming: FROZEN view of a file
+                # a producer may still be appending to — clamp to whole
+                # TOKEN_BLOCKs so a half-flushed tail (possibly not even
+                # itemsize-aligned) is never mapped, same guarantee the
+                # train path gets from StreamingTokenBin.
+                from frl_distributed_ml_scaffold_tpu.data.streaming import (
+                    TOKEN_BLOCK,
+                )
+
+                n_tok = os.path.getsize(path) // np.dtype(dtype).itemsize
+                n_tok = (n_tok // TOKEN_BLOCK) * TOKEN_BLOCK
+                self._mm = np.memmap(
+                    path, dtype=dtype, mode="r", shape=(n_tok,)
+                )
+            else:
+                self._mm = np.memmap(path, dtype=dtype, mode="r")
             vocab = sidecar.get("vocab_size")
             if vocab is not None and vocab > cfg.vocab_size:
                 raise ValueError(
@@ -139,6 +212,9 @@ class TokenBinLM:
             return self._fallback.batch(step, batch_size, host_offset)
         from frl_distributed_ml_scaffold_tpu.data import native
 
+        if self._stream is not None:
+            self._stream.maybe_refresh(step)  # see data/streaming.py
+            self._mm = self._stream.tokens
         cfg = self.cfg
         window = cfg.seq_len + 1  # input + next-token target share it
         rng = np.random.default_rng((self._seed, step, host_offset))

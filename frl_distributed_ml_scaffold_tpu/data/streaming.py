@@ -1,29 +1,35 @@
-"""Streaming (online-ingestion) view over a growing shard corpus (C16).
+"""Streaming (online-ingestion) views over growing corpora (C16).
 
 Closes the reference gap the offline tier left open (VERDICT r4 missing
 #5): the torch DataLoader can iterate a dataset that is still being
-produced; the mmap shard loaders here froze the corpus at construction.
-This module makes the shard directory APPEND-ONLY GROWABLE instead: a
-producer (tools/decode_imagenet.py / decode_video.py, a concurrent rsync
-from a decode farm, ...) keeps sealing new ``{split}_{kind}_XXX.npy`` +
-``{split}_labels_XXX.npy`` pairs into ``data.data_dir`` while training
-runs, and the loader periodically re-scans and widens its sampling window
-to the new data — no restart, no epoch machinery.
+produced; the mmap loaders here froze the corpus at construction. This
+module makes the corpus APPEND-ONLY GROWABLE instead — two shapes:
+
+- ``StreamingShardCorpus``: a producer (tools/decode_imagenet.py /
+  decode_video.py, a concurrent rsync from a decode farm, ...) keeps
+  sealing ``{split}_{kind}_XXX.npy`` + ``{split}_labels_XXX.npy`` pairs
+  into ``data.data_dir``; the loader widens its sampling window to the
+  new pairs.
+- ``StreamingTokenBin``: a tokenizer keeps APPENDING to ``{split}.bin``
+  (``append_token_bin`` in data/lm.py); the loader widens its token
+  window to the grown file.
 
 TPU-native design constraints drive the three decisions here:
 
-1. **Sealing by rename.** Producers write ``*.npy.tmp`` and
-   ``os.replace`` into the final name (the producers in tools/ do this
-   since round 5), so a scan never sees a torn shard. The scanner
-   additionally requires the LABELS shard of a pair to exist before the
-   pair is eligible — data-then-labels ordering makes label presence the
-   commit marker, whatever the producer.
+1. **Sealing.** Shard producers write ``*.npy.tmp`` and ``os.replace``
+   into the final name (the tools do this since round 5), so a scan
+   never sees a torn shard; the LABELS shard is the pair's commit
+   marker, and visibility is the longest index-contiguous prefix
+   (``aligned_pair_paths`` — robust to out-of-order delivery). Token
+   bins are append-only flat files: the visible count is the file
+   length rounded DOWN to a coarse block, so a half-flushed tail is
+   never sampled.
 
 2. **Hosts agree on the view — over the filesystem, never a collective.**
    Each host scans its own filesystem view, which can momentarily differ
    (NFS attribute caches); per-host batch *shapes* would still match, but
    sampling from different windows would silently skew the data
-   distribution across the DP axis. The agreement medium is the shard
+   distribution across the DP axis. The agreement medium is the corpus
    directory itself (the same design as the elastic supervisor's
    membership tier), as a LEADER-PUBLISHED WINDOW with deferred
    activation rather than a symmetric min (which lets two hosts read
@@ -36,7 +42,7 @@ TPU-native design constraints drive the three decisions here:
    that bucket. Refresh buckets are ``step // refresh_every`` and SPMD
    training keeps hosts within a collective's latency of each other, so
    a window published at bucket b is visible to every host's bucket-b+1
-   refresh: all hosts widen at the same step, to the same shard SET
+   refresh: all hosts widen at the same step, to the same unit SET
    (anchor + count, not count alone). A host that transiently cannot
    serve the window (NFS lag) defers one refresh and logs it. A device
    collective here would be a deadlock instead: ``maybe_refresh`` runs
@@ -49,22 +55,27 @@ TPU-native design constraints drive the three decisions here:
    "batches are a pure function of (seed, step)" cannot survive a corpus
    that grows on wall-clock time; what IS kept: between refreshes the
    view is frozen (same (seed, step) → same batch), every widening is
-   logged with its step and shard count, and ``state["shards"]`` exposes
-   the watermark for metrics. Exact cross-run reproduction requires
+   logged with its step and unit count, and ``state()`` exposes the
+   watermark for metrics. Exact cross-run reproduction requires
    replaying the same directory growth — stated here rather than
    pretended away.
 
 Reference parity note: torch's IterableDataset/DataLoader streaming
 (facebookresearch scaffold's data tier) delivers the same capability via
-per-worker iterators; the shard-watermark design replaces worker
-processes with the idempotent re-scan because the expensive decode work
-already happened offline (SURVEY §7 hard part 5).
+per-worker iterators; the watermark design replaces worker processes
+with the idempotent re-scan because the expensive decode work already
+happened offline (SURVEY §7 hard part 5).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import re
+import time
+from typing import Callable, Optional
+
+import numpy as np
 
 from frl_distributed_ml_scaffold_tpu.data.shards import (
     ShardedNpyCorpus,
@@ -80,91 +91,26 @@ def _sealed_pair_count(data_dir: str, split: str, kind: str) -> int:
     return len(aligned_pair_paths(data_dir, split, kind))
 
 
-class StreamingShardCorpus:
-    """A ``ShardedNpyCorpus`` whose shard window can widen over time.
+class _WindowProtocol:
+    """The leader-published window agreement (module docstring decision
+    2), generic over what a unit is: shard pairs or token blocks.
 
-    Drop-in for the frozen corpus (``found`` / ``n`` / ``item_shape`` /
-    ``gather`` delegate to the current view); the loader calls
-    ``maybe_refresh(step)`` once per batch and the view re-scans every
-    ``refresh_every`` steps. Shards already in the view are never
-    re-opened — append-only means existing mmaps stay valid.
+    ``scan`` returns this host's local ``(count, anchor)``;
+    ``self.visible`` is the currently adopted count (the subclass updates
+    it when it actually adopts a view).
     """
 
-    def __init__(self, data_dir: str, split: str, kind: str,
-                 refresh_every: int):
-        self.data_dir, self.split, self.kind = data_dir, split, kind
-        self.refresh_every = max(1, refresh_every)
-        # Construction is a one-time synchronization point: every host
-        # publishes, the leader computes and publishes the initial
-        # window (activate_at_bucket=0), every host waits bounded for it
-        # (jax.distributed init blocks the same way).
-        import time as _time
-
-        deadline = _time.monotonic() + 60.0
-        agreed = self._initial_window()
-        while agreed is None and _time.monotonic() < deadline:
-            _time.sleep(1.0)
-            agreed = self._initial_window()
-        if agreed is None:
-            raise ValueError(
-                f"data.streaming=true: no agreed initial window under "
-                f"{data_dir}/.stream_sync within 60s — are all hosts "
-                "pointing at the same shared data_dir?"
-            )
-        self._shards_visible = agreed
-        if self._shards_visible == 0:
-            # No sealed pair visible on SOME host (the count is the
-            # host-min, so every host takes this branch together).
-            # Refusing beats the two bad alternatives: an uncapped view
-            # can crash on a half-sealed pair (data half present, labels
-            # in flight), and a synthetic fallback would silently train
-            # on fake data forever — the loader's fallback check happens
-            # once, at construction.
-            raise ValueError(
-                f"data.streaming=true but {data_dir} has no sealed "
-                f"{split} {kind}+labels shard pair yet (on every host). "
-                "Start the producer first, or wait for its first flush — "
-                "the streaming loader refuses to guess."
-            )
-        self._view = ShardedNpyCorpus(
-            data_dir, split, kind, max_shards=self._shards_visible
-        )
-        self._next_refresh = self.refresh_every
-
-    # -- frozen-corpus surface -------------------------------------------
-    @property
-    def found(self) -> bool:
-        return self._view.found
-
-    @property
-    def n(self) -> int:
-        return self._view.n
-
-    @property
-    def item_shape(self):
-        return self._view.item_shape
-
-    def gather(self, idx):
-        return self._view.gather(idx)
-
-    # -- window-agreement protocol (decision 2 above) ---------------------
-    def _local_scan(self) -> tuple[int, int]:
-        """(count, anchor) of this host's sealed contiguous prefix;
-        anchor = first pair's index, -1 when empty."""
-        pairs = aligned_pair_paths(self.data_dir, self.split, self.kind)
-        if not pairs:
-            return 0, -1
-        import re as _re
-
-        m = _re.search(r"_(\d+)\.npy$", os.path.basename(pairs[0][0]))
-        return len(pairs), int(m.group(1)) if m else -1
+    def __init__(self, data_dir: str, tag: str,
+                 scan: Callable[[], tuple[int, int]]):
+        self.data_dir = data_dir
+        self.tag = tag
+        self.scan = scan
+        self.visible = 0
 
     def _sync_path(self, name: str) -> str:
         sync_dir = os.path.join(self.data_dir, ".stream_sync")
         os.makedirs(sync_dir, exist_ok=True)
-        return os.path.join(
-            sync_dir, f"{self.split}_{self.kind}_{name}.json"
-        )
+        return os.path.join(sync_dir, f"{self.tag}_{name}.json")
 
     def _publish(self, count: int, anchor: int, pidx: int) -> None:
         path = self._sync_path(f"host_{pidx}")
@@ -195,45 +141,148 @@ class StreamingShardCorpus:
         win = self._read_json("window")
         current = int(win["count"]) if win else 0
         # Also materialize the very first window even at target 0, so a
-        # no-shards-yet start FAILS FAST with the precise refusal below
+        # no-data-yet start FAILS FAST with the caller's precise refusal
         # instead of every follower timing out on an absent file.
-        if (win is None) or target > max(current, self._shards_visible):
+        if (win is None) or target > max(current, self.visible):
             tmp = self._sync_path("window") + ".tmp"
             with open(tmp, "w") as fh:
                 json.dump({"count": target, "anchor": my_anchor,
                            "activate_at_bucket": bucket + 1}, fh)
             os.replace(tmp, self._sync_path("window"))
 
-    def _initial_window(self):
-        """Construction-time agreement; returns the agreed count or None
-        (retry — a peer or the leader hasn't published yet)."""
-        count, anchor = self._local_scan()
+    def initial(self, deadline_s: float = 60.0) -> int:
+        """Construction-time agreement: every host publishes, the leader
+        publishes the initial window (activate_at_bucket=0), every host
+        waits bounded for it. Returns the agreed count (possibly 0 — the
+        caller decides whether 0 is a refusal)."""
+        deadline = time.monotonic() + deadline_s
+        while True:
+            count, anchor = self.scan()
+            import jax
+
+            n_proc = jax.process_count()
+            if n_proc <= 1:
+                return count
+            pidx = jax.process_index()
+            self._publish(count, anchor, pidx)
+            if pidx == 0:
+                self._leader_propose(n_proc, bucket=-1, my_anchor=anchor)
+            win = self._read_json("window")
+            if win is not None:
+                agreed = int(win["count"])
+                if agreed <= 0 or count >= agreed:
+                    # Stale window from an earlier run on the same dir is
+                    # fine — the corpus is append-only so it is servable,
+                    # and the first refresh converges every host onto the
+                    # leader's fresh proposals.
+                    return agreed
+                # NFS hasn't shown this host the full agreed prefix yet —
+                # retry within the deadline rather than serve a silently
+                # smaller view.
+            if time.monotonic() >= deadline:
+                raise ValueError(
+                    f"data.streaming=true: no agreed initial window for "
+                    f"{self.tag} under {self.data_dir}/.stream_sync within "
+                    f"{deadline_s:.0f}s — are all hosts pointing at the "
+                    "same shared data_dir?"
+                )
+            time.sleep(1.0)
+
+    def agree(self, bucket: int) -> Optional[tuple[int, int]]:
+        """One refresh round: publish, leader proposes, return the active
+        window ``(count, anchor)`` when it is bigger than ``visible`` —
+        else None (nothing to adopt this bucket)."""
+        count, anchor = self.scan()
         import jax
 
-        n_proc = jax.process_count()
-        if n_proc <= 1:
-            self._shards_visible = 0  # _leader_propose compares against it
-            return count
-        pidx = jax.process_index()
-        self._publish(count, anchor, pidx)
-        if pidx == 0:
-            self._shards_visible = 0
-            self._leader_propose(n_proc, bucket=-1, my_anchor=anchor)
+        if jax.process_count() <= 1:
+            return (count, anchor) if count > self.visible else None
+        self._publish(count, anchor, jax.process_index())
+        if jax.process_index() == 0:
+            self._leader_propose(jax.process_count(), bucket, anchor)
         win = self._read_json("window")
-        if win is None:
-            return None
-        agreed = int(win["count"])
-        if agreed > 0 and count < agreed:
-            # NFS hasn't shown this host the full agreed prefix yet —
-            # retry within the construction deadline rather than build a
-            # silently smaller view.
-            return None
-        # Stale window from an earlier run on the same dir: fine — the
-        # corpus is append-only so it is servable, and the first refresh
-        # converges every host onto the leader's fresh proposals.
-        return agreed
+        if (
+            win is not None
+            and int(win.get("activate_at_bucket", 0)) <= bucket
+            and int(win["count"]) > self.visible
+        ):
+            return int(win["count"]), int(win["anchor"])
+        return None
 
-    def _adopt(self, count: int, anchor: int, step: int) -> None:
+
+class StreamingShardCorpus:
+    """A ``ShardedNpyCorpus`` whose shard window can widen over time.
+
+    Drop-in for the frozen corpus (``found`` / ``n`` / ``item_shape`` /
+    ``gather`` delegate to the current view); the loader calls
+    ``maybe_refresh(step)`` once per batch and the view re-scans every
+    ``refresh_every`` steps. Shards already in the view are never
+    re-opened — append-only means existing mmaps stay valid.
+    """
+
+    def __init__(self, data_dir: str, split: str, kind: str,
+                 refresh_every: int):
+        self.data_dir, self.split, self.kind = data_dir, split, kind
+        self.refresh_every = max(1, refresh_every)
+        self._proto = _WindowProtocol(
+            data_dir, f"{split}_{kind}", self._local_scan
+        )
+        agreed = self._proto.initial()
+        if agreed == 0:
+            # No sealed pair visible on SOME host (the agreed count is a
+            # host-min, so every host takes this branch together).
+            # Refusing beats the two bad alternatives: an uncapped view
+            # can crash on a half-sealed pair (data half present, labels
+            # in flight), and a synthetic fallback would silently train
+            # on fake data forever — the loader's fallback check happens
+            # once, at construction.
+            raise ValueError(
+                f"data.streaming=true but {data_dir} has no sealed "
+                f"{split} {kind}+labels shard pair yet (on every host). "
+                "Start the producer first, or wait for its first flush — "
+                "the streaming loader refuses to guess."
+            )
+        self._proto.visible = agreed
+        self._view = ShardedNpyCorpus(
+            data_dir, split, kind, max_shards=agreed
+        )
+        self._next_refresh = self.refresh_every
+
+    def _local_scan(self) -> tuple[int, int]:
+        """(count, anchor) of this host's sealed contiguous prefix;
+        anchor = first pair's index, -1 when empty."""
+        pairs = aligned_pair_paths(self.data_dir, self.split, self.kind)
+        if not pairs:
+            return 0, -1
+        m = re.search(r"_(\d+)\.npy$", os.path.basename(pairs[0][0]))
+        return len(pairs), int(m.group(1)) if m else -1
+
+    # -- frozen-corpus surface -------------------------------------------
+    @property
+    def found(self) -> bool:
+        return self._view.found
+
+    @property
+    def n(self) -> int:
+        return self._view.n
+
+    @property
+    def item_shape(self):
+        return self._view.item_shape
+
+    def gather(self, idx):
+        return self._view.gather(idx)
+
+    # -- streaming surface -----------------------------------------------
+    def maybe_refresh(self, step: int) -> None:
+        if step < self._next_refresh:
+            return
+        bucket = step // self.refresh_every
+        self._next_refresh = (bucket + 1) * self.refresh_every
+        adopt = self._proto.agree(bucket)
+        if adopt is None:
+            return
+        count, anchor = adopt
         my_count, my_anchor = self._local_scan()
         if my_anchor != anchor or my_count < count:
             get_logger().warning(
@@ -259,35 +308,97 @@ class StreamingShardCorpus:
         get_logger().info(
             "streaming: widened %s/%s view %d -> %d shards "
             "(%d items) at step %d",
-            self.split, self.kind, self._shards_visible, count,
+            self.split, self.kind, self._proto.visible, count,
             new_view.n, step,
         )
-        self._shards_visible = count
+        self._proto.visible = count
         self._view = new_view
+
+    def state(self) -> dict:
+        """Watermark for metrics/observability (decision 3 above)."""
+        return {"shards": self._proto.visible, "items": self.n}
+
+
+#: Token-bin visibility granularity: the visible count rounds DOWN to
+#: this many tokens, so a producer's half-flushed tail is never sampled
+#: and window proposals stay coarse (one proposal per ~8k new tokens,
+#: not per write() syscall).
+TOKEN_BLOCK = 8192
+
+
+class StreamingTokenBin:
+    """A growing flat token binary (``{split}.bin``, data/lm.py format)
+    whose visible token count widens over time.
+
+    The producer APPENDS (``append_token_bin`` — same dtype enforced via
+    the sidecar); the visible window is the file length rounded down to
+    ``TOKEN_BLOCK`` tokens, agreed across hosts by the same
+    leader-window protocol as the shard tier (anchor is always 0: a flat
+    file has one possible prefix). ``tokens`` re-memmaps on widen —
+    cheap, and the old map stays valid because the file only grows.
+    """
+
+    def __init__(self, path: str, dtype, refresh_every: int):
+        self.path = path
+        self.dtype = np.dtype(dtype)
+        self.refresh_every = max(1, refresh_every)
+        self._proto = _WindowProtocol(
+            os.path.dirname(path) or ".",
+            os.path.basename(path).replace(".", "_"),
+            self._local_scan,
+        )
+        agreed = self._proto.initial()
+        if agreed == 0:
+            raise ValueError(
+                f"data.streaming=true but {path} holds fewer than "
+                f"{TOKEN_BLOCK} tokens (on every host). Start the "
+                "tokenizer/producer first — the streaming loader "
+                "refuses to guess."
+            )
+        self._proto.visible = agreed
+        self._mm = np.memmap(path, dtype=self.dtype, mode="r",
+                             shape=(agreed,))
+        self._next_refresh = self.refresh_every
+
+    def _local_scan(self) -> tuple[int, int]:
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return 0, 0
+        tokens = size // self.dtype.itemsize
+        return (tokens // TOKEN_BLOCK) * TOKEN_BLOCK, 0
+
+    def __len__(self) -> int:
+        return int(self._proto.visible)
+
+    @property
+    def tokens(self) -> np.ndarray:
+        return self._mm
 
     def maybe_refresh(self, step: int) -> None:
         if step < self._next_refresh:
             return
         bucket = step // self.refresh_every
         self._next_refresh = (bucket + 1) * self.refresh_every
-        count, anchor = self._local_scan()
-        import jax
-
-        if jax.process_count() <= 1:
-            if count > self._shards_visible:
-                self._adopt(count, anchor, step)
+        adopt = self._proto.agree(bucket)
+        if adopt is None:
             return
-        self._publish(count, anchor, jax.process_index())
-        if jax.process_index() == 0:
-            self._leader_propose(jax.process_count(), bucket, anchor)
-        win = self._read_json("window")
-        if (
-            win is not None
-            and int(win.get("activate_at_bucket", 0)) <= bucket
-            and int(win["count"]) > self._shards_visible
-        ):
-            self._adopt(int(win["count"]), int(win["anchor"]), step)
+        count, _ = adopt
+        my_count, _ = self._local_scan()
+        if my_count < count:
+            get_logger().warning(
+                "streaming: cannot serve agreed token window "
+                "(%d local < %d agreed) — NFS lag? deferring", my_count,
+                count,
+            )
+            return
+        get_logger().info(
+            "streaming: widened %s view %d -> %d tokens at step %d",
+            self.path, self._proto.visible, count, step,
+        )
+        self._proto.visible = count
+        self._mm = np.memmap(self.path, dtype=self.dtype, mode="r",
+                             shape=(count,))
 
     def state(self) -> dict:
-        """Watermark for metrics/observability (decision 3 above)."""
-        return {"shards": self._shards_visible, "items": self.n}
+        return {"tokens": int(self._proto.visible)}

@@ -130,6 +130,89 @@ def test_streaming_multihost_window_protocol(tmp_path, monkeypatch):
     assert leader.n == 16
 
 
+def test_streaming_token_bin_grows(tmp_path):
+    """The LM tier's online ingestion: a tokenizer keeps APPENDING to
+    {split}.bin; the loader's visible window widens (rounded down to
+    TOKEN_BLOCK so a half-flushed tail is never sampled) and freezes
+    between refreshes."""
+    from frl_distributed_ml_scaffold_tpu.data.lm import (
+        append_token_bin,
+        write_token_bin,
+    )
+    from frl_distributed_ml_scaffold_tpu.data.streaming import (
+        TOKEN_BLOCK,
+        StreamingTokenBin,
+    )
+
+    path = os.path.join(str(tmp_path), "train.bin")
+    rng = np.random.default_rng(0)
+    write_token_bin(path, rng.integers(0, 100, TOKEN_BLOCK + 100),
+                    vocab_size=100)
+    tb = StreamingTokenBin(path, np.uint16, refresh_every=10)
+    assert len(tb) == TOKEN_BLOCK  # tail below a block stays invisible
+
+    append_token_bin(path, rng.integers(0, 100, 2 * TOKEN_BLOCK))
+    tb.maybe_refresh(5)
+    assert len(tb) == TOKEN_BLOCK  # frozen between refreshes
+    tb.maybe_refresh(10)
+    assert len(tb) == 3 * TOKEN_BLOCK
+    assert tb.state() == {"tokens": 3 * TOKEN_BLOCK}
+
+    # The appender must refuse ids that don't fit the pinned dtype/vocab.
+    with pytest.raises(ValueError, match="vocab_size"):
+        append_token_bin(path, np.array([101]))
+
+
+def test_token_bin_dtype_sized_from_vocab_not_first_chunk(tmp_path):
+    """A declared 100k vocab must pin uint32 even when the first chunk's
+    ids happen to fit uint16 — else a later legal append wedges the
+    stream on an accidental dtype choice."""
+    from frl_distributed_ml_scaffold_tpu.data.lm import (
+        append_token_bin,
+        write_token_bin,
+    )
+
+    path = os.path.join(str(tmp_path), "train.bin")
+    write_token_bin(path, np.arange(100), vocab_size=100_000)
+    append_token_bin(path, np.array([70_000]))  # legal id, needs uint32
+    mm = np.memmap(path, dtype=np.uint32, mode="r")
+    assert int(mm[-1]) == 70_000
+
+
+def test_streaming_lm_loader_end_to_end(tmp_path):
+    """TokenBinLM with data.streaming=true samples only the visible
+    window and widens to appended tokens at the refresh boundary."""
+    from frl_distributed_ml_scaffold_tpu.config.schema import DataConfig
+    from frl_distributed_ml_scaffold_tpu.data.lm import (
+        TokenBinLM,
+        append_token_bin,
+        write_token_bin,
+    )
+    from frl_distributed_ml_scaffold_tpu.data.streaming import TOKEN_BLOCK
+
+    path = os.path.join(str(tmp_path), "train.bin")
+    # First block all-zeros, appended block all-ones: batch contents
+    # reveal which window a sample came from.
+    write_token_bin(path, np.zeros(TOKEN_BLOCK, np.int64), vocab_size=4)
+    cfg = DataConfig(
+        name="lm", global_batch_size=4, seq_len=64, vocab_size=4,
+        data_dir=str(tmp_path), streaming=True, streaming_refresh_every=4,
+        prefetch=0,
+    )
+    loader = TokenBinLM(cfg, split="train")
+    assert not loader.is_synthetic
+    assert int(loader.batch(0, 4)["tokens"].max()) == 0
+
+    append_token_bin(path, np.ones(TOKEN_BLOCK, np.int64))
+    for step in range(1, 4):
+        assert int(loader.batch(step, 4)["tokens"].max()) == 0
+    seen_one = any(
+        int(loader.batch(step, 4)["tokens"].max()) == 1
+        for step in range(4, 40)
+    )
+    assert seen_one  # widened window reaches the appended tokens
+
+
 def test_streaming_loader_end_to_end(tmp_path):
     d = str(tmp_path)
     _write_shard(d, 0, n=16, size=8)
