@@ -75,6 +75,46 @@ def _save_last_good(result: dict) -> None:
         _progress(f"could not save last-good record: {e}")
 
 
+def _corroborated(rec: dict) -> bool:
+    """A cached record may only be re-emitted as a stale measurement if
+    the evidence trail actually contains it: the metric's config family
+    must have a BENCH_TABLE.jsonl protocol row whose samples/sec agrees
+    within 25%. A hand-edited or corrupted cache must degrade to the
+    honest error object, not get republished wearing a 'measured' label.
+    """
+    # A corrupted cache/table must yield False, never a traceback — the
+    # caller's contract is "exactly one final JSON line, whatever
+    # happens", and the garbage inputs this guard exists for are exactly
+    # the ones that make float()/dict access raise.
+    try:
+        metric = str(rec.get("metric", ""))
+        value = float(rec["value"])
+        config_by_metric = {
+            "rn50_imagenet_samples_per_sec_per_chip": "imagenet_rn50_ddp",
+            "mnist_mlp_samples_per_sec_per_chip": "mnist_mlp",
+        }
+        config = config_by_metric.get(metric)
+        if config is None:
+            return False
+        table = os.path.join(
+            os.path.dirname(LAST_GOOD_PATH), "BENCH_TABLE.jsonl"
+        )
+        with open(table) as fh:
+            rows = [json.loads(line) for line in fh if line.strip()]
+        for row in rows:
+            if (
+                isinstance(row, dict)
+                and row.get("config") == config
+                and "samples_per_sec_per_chip" in row
+            ):
+                measured = float(row["samples_per_sec_per_chip"])
+                if measured > 0 and abs(value - measured) <= 0.25 * measured:
+                    return True
+        return False
+    except Exception:
+        return False
+
+
 def _emit_stale_or_error(error: str) -> int:
     """Final-line fallback: most recent real measurement marked stale, or —
     only if none was ever captured — the bare error object.
@@ -89,6 +129,13 @@ def _emit_stale_or_error(error: str) -> int:
         with open(LAST_GOOD_PATH) as fh:
             rec = json.load(fh)
     except (OSError, ValueError):
+        rec = None
+    if rec and "value" in rec and not _corroborated(rec):
+        _progress(
+            "last-good record is NOT corroborated by BENCH_TABLE.jsonl "
+            "(hand-edited or corrupted cache?); refusing to re-emit it "
+            "as a stale measurement"
+        )
         rec = None
     if rec and "value" in rec:
         rec["stale"] = True

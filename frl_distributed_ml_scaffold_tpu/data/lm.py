@@ -139,6 +139,17 @@ class TokenBinLM:
                 # data_dir was explicitly configured: falling back to random
                 # synthetic tokens without saying so would silently train on
                 # noise (same class of trap as the mesh/opt-state fallbacks).
+                if cfg.streaming and split == "train":
+                    # Streaming's whole point is "start before the
+                    # producer finishes" — but a missing bin must REFUSE
+                    # like the shard tier, not quietly train on noise
+                    # forever (the fallback decision happens once, here).
+                    raise ValueError(
+                        f"data.streaming=true but {path} does not exist. "
+                        "Start the tokenizer/producer first (write_token_"
+                        "bin creates the bin + sidecar) — the streaming "
+                        "loader refuses to guess."
+                    )
                 _logger().warning(
                     "lm data: data_dir=%s has no %s.bin — falling back to "
                     "SYNTHETIC random tokens; fix data.data_dir if a real "
@@ -172,16 +183,20 @@ class TokenBinLM:
                 self._mm = self._stream.tokens
             elif cfg.streaming:
                 # Non-train splits under streaming: FROZEN view of a file
-                # a producer may still be appending to — clamp to whole
-                # TOKEN_BLOCKs so a half-flushed tail (possibly not even
-                # itemsize-aligned) is never mapped, same guarantee the
-                # train path gets from StreamingTokenBin.
+                # a producer may still be appending to — always clamp to
+                # whole tokens (a torn byte-tail would fail the memmap),
+                # and to whole TOKEN_BLOCKs when the file is big enough
+                # for that to matter. Small static eval bins keep their
+                # full token-aligned length: zeroing a 5k-token val.bin
+                # because the TRAIN stream is online would break eval for
+                # a file nothing is appending to.
                 from frl_distributed_ml_scaffold_tpu.data.streaming import (
                     TOKEN_BLOCK,
                 )
 
                 n_tok = os.path.getsize(path) // np.dtype(dtype).itemsize
-                n_tok = (n_tok // TOKEN_BLOCK) * TOKEN_BLOCK
+                if n_tok >= TOKEN_BLOCK:
+                    n_tok = (n_tok // TOKEN_BLOCK) * TOKEN_BLOCK
                 self._mm = np.memmap(
                     path, dtype=dtype, mode="r", shape=(n_tok,)
                 )
