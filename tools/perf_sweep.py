@@ -84,6 +84,24 @@ def measure(name, overrides, n=12, warm=3):
     del t, s, b
     return dt
 
+
+def measure_or_emit(experiment, bs, name, overrides, tag, *, n=12, warm=3):
+    """measure() + emit(), recording failures as rows instead of aborting
+    the sweep — HBM-rejected combos are DATA (they map the memory wall).
+    One implementation for every grid that wants keep-sweeping semantics.
+    """
+    try:
+        dt = measure(name, overrides, n=n, warm=warm)
+        emit(experiment, bs, dt, tag)
+    except Exception as e:
+        print(
+            json.dumps(
+                {"experiment": experiment, "global_batch_size": bs,
+                 **tag, "error": str(e)[:160]}
+            ),
+            flush=True,
+        )
+
 def rn50_bs():
     """Throughput knee: where does adding batch stop helping?"""
     for bs in (256, 512, 768, 1024):
@@ -231,26 +249,16 @@ def gpt2_opt():
     for opt in ("adamw", "adafactor", "lion"):
         for mb in (4, 8, 16):
             for remat in ("dots", "none"):
-                tag = {"optimizer": opt, "remat": remat}
-                try:
-                    dt = measure(
-                        "gpt2_medium_zero1",
-                        base + [
-                            f"optimizer.name={opt}",
-                            f"data.global_batch_size={mb}",
-                            f"trainer.remat={remat}",
-                        ],
-                        n=10, warm=3,
-                    )
-                    emit("gpt2_opt", mb, dt, tag)
-                except Exception as e:
-                    print(
-                        json.dumps(
-                            {"experiment": "gpt2_opt", "global_batch_size": mb,
-                             **tag, "error": str(e)[:160]}
-                        ),
-                        flush=True,
-                    )
+                measure_or_emit(
+                    "gpt2_opt", mb, "gpt2_medium_zero1",
+                    base + [
+                        f"optimizer.name={opt}",
+                        f"data.global_batch_size={mb}",
+                        f"trainer.remat={remat}",
+                    ],
+                    {"optimizer": opt, "remat": remat},
+                    n=10, warm=3,
+                )
 
 
 def gpt2_block_remat():
@@ -277,26 +285,36 @@ def gpt2_block_remat():
     emit("gpt2_block_remat", 4, dt, {"remat": "dots", "block_remat": "none"})
     for br in ("save_attn", "full"):
         for mb in (8, 16, 32):
-            tag = {"remat": "none", "block_remat": br}
-            try:
-                dt = measure(
-                    "gpt2_medium_zero1",
-                    base + [
-                        f"model.block_remat={br}",
-                        f"data.global_batch_size={mb}",
-                    ],
-                    n=10, warm=3,
-                )
-                emit("gpt2_block_remat", mb, dt, tag)
-            except Exception as e:
-                print(
-                    json.dumps(
-                        {"experiment": "gpt2_block_remat",
-                         "global_batch_size": mb, **tag,
-                         "error": str(e)[:160]}
-                    ),
-                    flush=True,
-                )
+            measure_or_emit(
+                "gpt2_block_remat", mb, "gpt2_medium_zero1",
+                base + [
+                    f"model.block_remat={br}",
+                    f"data.global_batch_size={mb}",
+                ],
+                {"remat": "none", "block_remat": br},
+                n=10, warm=3,
+            )
+
+
+def moe_dispatch():
+    """Round-5 A/B the FLOP table predicts sort wins (einsum exchange =
+    66% of step FLOPs at the audited shapes; sort cuts total 1.79x —
+    tools/moe_dispatch_cost.py / docs/perf_playbook.md "Dispatch
+    FLOPs"). Measures the full gpt2_moe single-chip protocol operating
+    point under each moe.dispatch; the recipe default flips only if the
+    measured step time agrees with the cost model (BACKLOG R5-2)."""
+    base = [
+        "data.global_batch_size=8", "trainer.grad_accum=1",
+        "model.attention=flash", "model.lm_loss_chunk=128",
+        "mesh.expert=1", "optimizer.name=adafactor",
+        "trainer.remat=none", "model.block_remat=full",
+    ]
+    for dispatch in ("einsum", "sort"):
+        measure_or_emit(
+            "moe_dispatch", 8, "gpt2_moe",
+            base + [f"model.moe.dispatch={dispatch}"],
+            {"dispatch": dispatch}, n=10, warm=3,
+        )
 
 
 def gpt2_offload():
@@ -311,25 +329,15 @@ def gpt2_offload():
     ]
     for opt in ("adamw", "adafactor"):
         for mb in (8, 16, 32):
-            try:
-                dt = measure(
-                    "gpt2_medium_zero1",
-                    base + [
-                        f"optimizer.name={opt}",
-                        f"data.global_batch_size={mb}",
-                        "trainer.remat=dots",
-                    ],
-                    n=8, warm=3,
-                )
-                emit("gpt2_offload", mb, dt, {"optimizer": opt})
-            except Exception as e:
-                print(
-                    json.dumps(
-                        {"experiment": "gpt2_offload", "optimizer": opt,
-                         "global_batch_size": mb, "error": str(e)[:160]}
-                    ),
-                    flush=True,
-                )
+            measure_or_emit(
+                "gpt2_offload", mb, "gpt2_medium_zero1",
+                base + [
+                    f"optimizer.name={opt}",
+                    f"data.global_batch_size={mb}",
+                    "trainer.remat=dots",
+                ],
+                {"optimizer": opt}, n=8, warm=3,
+            )
 
 
 def rn50_fused_opt():
@@ -351,7 +359,7 @@ GROUPS = {f.__name__: f for f in (rn50_bs, rn50_precision, rn50_fwd_only,
                                   rn50_depth, rn50_stem, rn50_split, vitb,
                                   rn50_headline, rn50_pool, gpt2_opt,
                                   gpt2_block_remat, gpt2_offload,
-                                  rn50_fused_opt)}
+                                  rn50_fused_opt, moe_dispatch)}
 
 if __name__ == "__main__":
     which = sys.argv[1:] or list(GROUPS)

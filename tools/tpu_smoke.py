@@ -168,6 +168,48 @@ def main() -> int:
     emit("opt_state_offload_on_chip", ok, memory_kinds=kinds,
          loss0=round(l0, 4), loss_last=round(l_last, 4))
 
+    # --- MoE sort-vs-einsum dispatch on real Mosaic (round 5) -----------
+    # CI pins exact equivalence on the CPU sim; the chip check is that
+    # the scatter/gather formulation COMPILES for TPU and agrees there
+    # too (gather/scatter lowering differs materially from CPU).
+    import dataclasses as _dc
+
+    from frl_distributed_ml_scaffold_tpu.config.schema import GPTConfig, MoEConfig
+    from frl_distributed_ml_scaffold_tpu.models.moe import MoEMlp
+
+    t0 = time.time()
+    gcfg = GPTConfig(
+        hidden_dim=128, num_heads=4, seq_len=64,
+        moe=MoEConfig(num_experts=8, top_k=2, num_groups=1),
+    )
+    x = jax.random.normal(jax.random.key(0), (4, 64, 128), jnp.float32)
+    outs = {}
+    # Highest matmul precision: the einsum path's exchange runs on the
+    # MXU while sort's gathers are exact, so default-precision error
+    # (~4e-3 relative — see the flash calibration above) would not
+    # cancel between the two paths and could false-fail the check.
+    with jax.default_matmul_precision("highest"):
+        for dispatch in ("einsum", "sort"):
+            m = MoEMlp(
+                _dc.replace(
+                    gcfg, moe=_dc.replace(gcfg.moe, dispatch=dispatch)
+                ),
+                jnp.float32,
+            )
+            variables = jax.jit(
+                lambda v, _m=m: _m.init(jax.random.key(1), v, train=True)
+            )(x)
+            outs[dispatch] = jax.jit(
+                lambda v, xx, _m=m: _m.apply(v, xx, train=True)
+            )(variables, x)
+    err = float(
+        jnp.max(jnp.abs(outs["einsum"][0] - outs["sort"][0]))
+    )
+    ok = err < 1e-4
+    failures += not ok
+    emit("moe_sort_dispatch_on_chip", ok, max_abs_err=err,
+         seconds=round(time.time() - t0, 1))
+
     emit("summary", failures == 0, failures=failures)
     return 1 if failures else 0
 
