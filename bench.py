@@ -60,8 +60,15 @@ CANDIDATE_TIMEOUT_S = int(os.environ.get("FRL_BENCH_CANDIDATE_TIMEOUT_S", "720")
 #: relay is down at bench time, so an outage degrades the record to "most
 #: recent real measurement + its capture timestamp" instead of an error
 #: object that carries no performance information at all.
-LAST_GOOD_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                              "bench_last_good.json")
+#:
+#: Env-overridable (FRL_BENCH_LAST_GOOD_PATH) so tests that drive main()'s
+#: save path write a sandbox file instead of poisoning the committed
+#: evidence cache with fixture values — which is exactly what happened
+#: through round 5: every pytest run stamped value=123.0 into the repo
+#: copy, so the tier-1 stale fallback could never fire with real data.
+LAST_GOOD_PATH = os.environ.get("FRL_BENCH_LAST_GOOD_PATH") or os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "bench_last_good.json"
+)
 
 
 def _save_last_good(result: dict) -> None:

@@ -248,6 +248,13 @@ class ResNetConfig:
     # whose tie semantics split the gradient equally across tied maxima
     # (models/resnet.py::_max_pool_mask_grad).
     pool_grad: str = "scatter"
+    # Fused BatchNorm-backward Pallas kernel (ops/fused_bn.py): identical
+    # forward, train-mode backward replaced by the two-pass reduction+dx
+    # kernel chain attacking the measured ~150 ms/step of HBM-bound
+    # BN-backward traffic (docs/perf_playbook.md roofline). Ships off by
+    # default until tools/perf_sweep.py rn50_fused_bn measures the win
+    # on-chip (the fused_adamw honesty contract).
+    fused_bn: bool = False
 
 
 @dataclass(frozen=True)

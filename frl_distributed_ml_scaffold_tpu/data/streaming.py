@@ -139,11 +139,18 @@ class _WindowProtocol:
             counts.append(int(rec["count"]))
         target = min(counts)
         win = self._read_json("window")
-        current = int(win["count"]) if win else 0
+        # A leftover window from an EARLIER corpus in this directory names
+        # a different anchor — its count is incomparable with the current
+        # unit set, and every live host just published the new anchor, so
+        # the leader has full information to repair it. Without this,
+        # a stale larger-count window could never be overwritten and the
+        # followers' anchor guard would spin to the deadline.
+        stale_anchor = win is not None and int(win.get("anchor", -1)) != my_anchor
+        current = 0 if (win is None or stale_anchor) else int(win["count"])
         # Also materialize the very first window even at target 0, so a
         # no-data-yet start FAILS FAST with the caller's precise refusal
         # instead of every follower timing out on an absent file.
-        if (win is None) or target > max(current, self.visible):
+        if win is None or stale_anchor or target > max(current, self.visible):
             tmp = self._sync_path("window") + ".tmp"
             with open(tmp, "w") as fh:
                 json.dump({"count": target, "anchor": my_anchor,
@@ -170,15 +177,25 @@ class _WindowProtocol:
             win = self._read_json("window")
             if win is not None:
                 agreed = int(win["count"])
-                if agreed <= 0 or count >= agreed:
-                    # Stale window from an earlier run on the same dir is
-                    # fine — the corpus is append-only so it is servable,
-                    # and the first refresh converges every host onto the
-                    # leader's fresh proposals.
+                if agreed > 0 and int(win.get("anchor", -1)) != anchor:
+                    # The published window names a different unit SET than
+                    # this host sees — a stale .stream_sync file from an
+                    # earlier corpus in the same directory (the docstring's
+                    # "clear .stream_sync first" footgun), or this host's
+                    # view lagging a prefix rotation. Adopting it would
+                    # silently map indices onto the wrong units: keep
+                    # waiting for a window matching the local anchor and
+                    # fail loudly at the deadline instead.
+                    win = None
+                elif agreed <= 0 or count >= agreed:
+                    # A same-anchor window from an earlier run on the same
+                    # dir is fine — the corpus is append-only so it is
+                    # servable, and the first refresh converges every host
+                    # onto the leader's fresh proposals.
                     return agreed
-                # NFS hasn't shown this host the full agreed prefix yet —
-                # retry within the deadline rather than serve a silently
-                # smaller view.
+                # else: NFS hasn't shown this host the full agreed prefix
+                # yet — retry within the deadline rather than serve a
+                # silently smaller view.
             if time.monotonic() >= deadline:
                 raise ValueError(
                     f"data.streaming=true: no agreed initial window for "
@@ -208,6 +225,37 @@ class _WindowProtocol:
         ):
             return int(win["count"]), int(win["anchor"])
         return None
+
+
+#: Adoption retries within one refresh bucket before falling back to the
+#: bucket boundary: a transient NFS attribute-cache lag clears within a
+#: batch or two, but a permanently unservable window (rotated corpus,
+#: mid-run anchor mismatch) must not pay a directory scan + sync publish +
+#: warning line on EVERY batch for the rest of the run.
+RETRY_BUDGET_PER_BUCKET = 8
+
+
+def _defer_adoption(view, step: int, bucket: int, why: str, *args) -> None:
+    """Shared retry policy for both streaming tiers (shard + token bin).
+
+    An agreed window ``view`` cannot serve yet: RETRY on the very next
+    batch (the window is already active on peers, so every deferred step
+    trains on a stale skew of the data distribution across the DP axis) —
+    but only ``RETRY_BUDGET_PER_BUCKET`` times per bucket, then defer to
+    the boundary. ``view`` needs ``refresh_every`` plus the
+    ``_skew_deferrals`` / ``_bucket_retries`` / ``_next_refresh``
+    attributes; the skew counter rides ``state()`` so lag is observable.
+    """
+    view._skew_deferrals += 1
+    view._bucket_retries += 1
+    if view._bucket_retries <= RETRY_BUDGET_PER_BUCKET:
+        view._next_refresh = step + 1
+        suffix = " — retrying next batch"
+    else:
+        view._next_refresh = (bucket + 1) * view.refresh_every
+        suffix = (" — retry budget exhausted this bucket, deferring to "
+                  "the next refresh bucket")
+    get_logger().warning("streaming: " + why + suffix, *args)
 
 
 class StreamingShardCorpus:
@@ -247,6 +295,9 @@ class StreamingShardCorpus:
             data_dir, split, kind, max_shards=agreed
         )
         self._next_refresh = self.refresh_every
+        self._skew_deferrals = 0
+        self._bucket_retries = 0
+        self._bucket = -1
 
     def _local_scan(self) -> tuple[int, int]:
         """(count, anchor) of this host's sealed contiguous prefix;
@@ -278,16 +329,19 @@ class StreamingShardCorpus:
         if step < self._next_refresh:
             return
         bucket = step // self.refresh_every
-        self._next_refresh = (bucket + 1) * self.refresh_every
+        if bucket != self._bucket:
+            self._bucket, self._bucket_retries = bucket, 0
         adopt = self._proto.agree(bucket)
         if adopt is None:
+            # Nothing newly active this bucket: next check at the boundary.
+            self._next_refresh = (bucket + 1) * self.refresh_every
             return
         count, anchor = adopt
         my_count, my_anchor = self._local_scan()
         if my_anchor != anchor or my_count < count:
-            get_logger().warning(
-                "streaming: cannot serve agreed window (anchor %d/%d, "
-                "count %d/%d) — NFS lag? deferring one refresh",
+            _defer_adoption(
+                self, step, bucket,
+                "cannot serve agreed window (anchor %d/%d, count %d/%d)",
                 my_anchor, anchor, my_count, count,
             )
             return
@@ -296,15 +350,16 @@ class StreamingShardCorpus:
                 self.data_dir, self.split, self.kind, max_shards=count
             )
         except ValueError as e:
-            # A transiently inconsistent directory must defer one
-            # refresh, never kill a training run mid-flight.
-            get_logger().warning(
-                "streaming: refresh deferred (inconsistent shard view: "
-                "%s)", e
+            # A transiently inconsistent directory must never kill a
+            # training run mid-flight.
+            _defer_adoption(
+                self, step, bucket, "inconsistent shard view: %s", e
             )
             return
         if not new_view.found:
-            return  # racing producer wrote garbage; keep the old view
+            # Racing producer wrote garbage; keep the old view.
+            _defer_adoption(self, step, bucket, "agreed window not readable")
+            return
         get_logger().info(
             "streaming: widened %s/%s view %d -> %d shards "
             "(%d items) at step %d",
@@ -313,10 +368,15 @@ class StreamingShardCorpus:
         )
         self._proto.visible = count
         self._view = new_view
+        self._next_refresh = (bucket + 1) * self.refresh_every
 
     def state(self) -> dict:
         """Watermark for metrics/observability (decision 3 above)."""
-        return {"shards": self._proto.visible, "items": self.n}
+        return {
+            "shards": self._proto.visible,
+            "items": self.n,
+            "skew_deferrals": self._skew_deferrals,
+        }
 
 
 #: Token-bin visibility granularity: the visible count rounds DOWN to
@@ -359,6 +419,9 @@ class StreamingTokenBin:
         self._mm = np.memmap(path, dtype=self.dtype, mode="r",
                              shape=(agreed,))
         self._next_refresh = self.refresh_every
+        self._skew_deferrals = 0
+        self._bucket_retries = 0
+        self._bucket = -1
 
     def _local_scan(self) -> tuple[int, int]:
         try:
@@ -379,17 +442,21 @@ class StreamingTokenBin:
         if step < self._next_refresh:
             return
         bucket = step // self.refresh_every
-        self._next_refresh = (bucket + 1) * self.refresh_every
+        if bucket != self._bucket:
+            self._bucket, self._bucket_retries = bucket, 0
         adopt = self._proto.agree(bucket)
         if adopt is None:
+            self._next_refresh = (bucket + 1) * self.refresh_every
             return
         count, _ = adopt
         my_count, _ = self._local_scan()
         if my_count < count:
-            get_logger().warning(
-                "streaming: cannot serve agreed token window "
-                "(%d local < %d agreed) — NFS lag? deferring", my_count,
-                count,
+            # Same retry-within-bucket contract (and budget) as the shard
+            # tier — one shared policy, _defer_adoption.
+            _defer_adoption(
+                self, step, bucket,
+                "cannot serve agreed token window (%d local < %d agreed)",
+                my_count, count,
             )
             return
         get_logger().info(
@@ -399,6 +466,10 @@ class StreamingTokenBin:
         self._proto.visible = count
         self._mm = np.memmap(self.path, dtype=self.dtype, mode="r",
                              shape=(count,))
+        self._next_refresh = (bucket + 1) * self.refresh_every
 
     def state(self) -> dict:
-        return {"tokens": int(self._proto.visible)}
+        return {
+            "tokens": int(self._proto.visible),
+            "skew_deferrals": self._skew_deferrals,
+        }

@@ -80,7 +80,7 @@ def permute(x: Any, axis: str, perm: Sequence[tuple[int, int]]) -> Any:
 
 def ring_shift(x: Any, axis: str, *, shift: int = 1) -> Any:
     """Rotate shards around the axis ring by ``shift`` (ring-attention step)."""
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return permute(x, axis, perm)
 
@@ -103,8 +103,16 @@ def axis_index(axis: str):
 
 
 def axis_size(axis: str):
-    """Size of the mesh axis (reference: group world size)."""
-    return lax.axis_size(axis)
+    """Size of the mesh axis (reference: group world size).
+
+    ``lax.axis_size`` only exists on newer jax; older releases statically
+    fold ``psum(1, axis)`` of a Python literal to the same int — the
+    classic idiom, kept as the fallback so ring/Ulysses hop counts stay
+    compile-time constants on both.
+    """
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis)
+    return lax.psum(1, axis)
 
 
 # ------------------------------ host tier ---------------------------------

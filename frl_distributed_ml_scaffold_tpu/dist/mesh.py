@@ -203,6 +203,26 @@ class mesh_context:
         return False
 
 
+def shard_map_compat(fn, *, mesh: Mesh, in_specs, out_specs):
+    """``shard_map`` across the jax API move: new jax exposes
+    ``jax.shard_map(..., check_vma=)``, older releases only
+    ``jax.experimental.shard_map.shard_map(..., check_rep=)``. Every
+    manual-collective op routes through here so the repo runs on both.
+    Replication checking is disabled either way: callers' out_specs declare
+    intent (psum'd outputs are replicated by construction)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=False,
+    )
+
+
 def local_batch_size(global_batch_size: int, env: MeshEnv | None = None) -> int:
     """Per-host batch share (reference: per-rank batch). Validates evenness."""
     n_proc = jax.process_count()

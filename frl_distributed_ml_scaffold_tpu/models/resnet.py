@@ -222,8 +222,19 @@ class ResNet(nn.Module):
         cfg = self.config
         dtype = self.policy.compute_dtype
         conv = partial(nn.Conv, use_bias=False, dtype=dtype, padding="SAME")
+        if cfg.fused_bn:
+            # Same forward, fused Pallas backward (ops/fused_bn.py) — the
+            # params/batch_stats tree is identical, so checkpoints and
+            # partition rules are oblivious to the switch.
+            from frl_distributed_ml_scaffold_tpu.ops.fused_bn import (
+                FusedBatchNorm,
+            )
+
+            bn_cls = FusedBatchNorm
+        else:
+            bn_cls = nn.BatchNorm
         norm = partial(
-            nn.BatchNorm,
+            bn_cls,
             use_running_average=not train,
             momentum=0.9,
             epsilon=1e-5,

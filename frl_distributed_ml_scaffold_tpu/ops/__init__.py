@@ -22,6 +22,10 @@ model's ``attention=`` config switch.
 """
 
 from frl_distributed_ml_scaffold_tpu.ops.flash_attention import flash_attention
+from frl_distributed_ml_scaffold_tpu.ops.fused_bn import (
+    FusedBatchNorm,
+    fused_bn_train,
+)
 from frl_distributed_ml_scaffold_tpu.ops.ring_attention import (
     dense_attention,
     ring_attention,

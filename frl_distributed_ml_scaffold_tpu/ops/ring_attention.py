@@ -62,12 +62,13 @@ def ring_attention(
     inner = partial(
         _ring_shard_fn, axis_name=axis_name, causal=causal, interpret=interpret
     )
-    return jax.shard_map(
+    from frl_distributed_ml_scaffold_tpu.dist.mesh import shard_map_compat
+
+    return shard_map_compat(
         inner,
         mesh=env.mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        check_vma=False,
     )(q, k, v)
 
 
@@ -95,8 +96,10 @@ def _ring_fwd_loop(q, k, v, axis_name, causal, interpret):
         block_attention_fwd,
     )
 
+    from frl_distributed_ml_scaffold_tpu.dist.collectives import axis_size
+
     idx = lax.axis_index(axis_name)
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     # Hop 0: the diagonal block (q and k share a position origin).
@@ -151,8 +154,10 @@ def _ring_bwd_rule(axis_name, causal, interpret, res, do):
     )
 
     q, k, v, o, lse = res
+    from frl_distributed_ml_scaffold_tpu.dist.collectives import axis_size
+
     idx = lax.axis_index(axis_name)
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     # Hop 0: diagonal. dK/dV accumulators then TRAVEL with their block

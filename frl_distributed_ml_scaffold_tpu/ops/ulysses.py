@@ -54,12 +54,13 @@ def ulysses_attention(
     inner = partial(
         _ulysses_shard_fn, axis_name=axis_name, causal=causal, interpret=interpret
     )
-    return jax.shard_map(
+    from frl_distributed_ml_scaffold_tpu.dist.mesh import shard_map_compat
+
+    return shard_map_compat(
         inner,
         mesh=env.mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        check_vma=False,
     )(q, k, v)
 
 

@@ -2,6 +2,12 @@
 the simulated mesh (numbers are meaningless on CPU; the lowering is what
 CI asserts — a pod runs the same tool for real ICI/DCN bandwidth)."""
 
+
+import pytest as _pytest_mark  # noqa: E402
+
+# Sub-2-minute smoke tier (COVERAGE.md "Test tiers"): this module's
+# measured wall time keeps `pytest -m fast` under the tier budget.
+pytestmark = _pytest_mark.mark.fast
 import json
 import os
 import subprocess

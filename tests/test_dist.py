@@ -7,7 +7,6 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
 
 from frl_distributed_ml_scaffold_tpu.config.schema import MeshConfig
 from frl_distributed_ml_scaffold_tpu.dist import build_mesh, collectives, local_batch_size
@@ -79,8 +78,10 @@ def test_local_batch_size_single_process():
 
 
 def _shmap(fn, mesh, in_specs, out_specs):
+    from frl_distributed_ml_scaffold_tpu.dist.mesh import shard_map_compat
+
     return jax.jit(
-        shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+        shard_map_compat(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
     )
 
 

@@ -1,6 +1,12 @@
 """EMA of params (trainer.ema_decay): updated inside the compiled step,
 sharded like the params, used by evaluation, checkpointed with the state."""
 
+
+import pytest as _pytest_mark  # noqa: E402
+
+# Sub-2-minute smoke tier (COVERAGE.md "Test tiers"): this module's
+# measured wall time keeps `pytest -m fast` under the tier budget.
+pytestmark = _pytest_mark.mark.fast
 import jax
 import jax.numpy as jnp
 import numpy as np

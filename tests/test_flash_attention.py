@@ -6,6 +6,12 @@ kernels the TPU compiles) and asserts forward and gradient equivalence with
 """
 
 from __future__ import annotations
+import pytest as _pytest_mark  # noqa: E402
+
+# Sub-2-minute smoke tier (COVERAGE.md "Test tiers"): this module's
+# measured wall time keeps `pytest -m fast` under the tier budget.
+pytestmark = _pytest_mark.mark.fast
+
 
 import jax
 import jax.numpy as jnp

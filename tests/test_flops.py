@@ -2,6 +2,12 @@
 with XLA's own cost analysis where that exists (CPU), wired as the bench
 MFU fallback for backends without cost analysis."""
 
+
+import pytest as _pytest_mark  # noqa: E402
+
+# Sub-2-minute smoke tier (COVERAGE.md "Test tiers"): this module's
+# measured wall time keeps `pytest -m fast` under the tier budget.
+pytestmark = _pytest_mark.mark.fast
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -1,6 +1,12 @@
 """Token-bin LM corpus loader (SURVEY C16): producer/consumer round-trip,
 deterministic step-indexed sampling, synthetic fallback, trainer wiring."""
 
+
+import pytest as _pytest_mark  # noqa: E402
+
+# Sub-2-minute smoke tier (COVERAGE.md "Test tiers"): this module's
+# measured wall time keeps `pytest -m fast` under the tier budget.
+pytestmark = _pytest_mark.mark.fast
 import json
 import os
 

@@ -2,6 +2,12 @@
 a logger (native core loader, offline tools) must never trigger device
 bring-up — on an unreachable TPU relay that blocks forever (observed)."""
 
+
+import pytest as _pytest_mark  # noqa: E402
+
+# Sub-2-minute smoke tier (COVERAGE.md "Test tiers"): this module's
+# measured wall time keeps `pytest -m fast` under the tier budget.
+pytestmark = _pytest_mark.mark.fast
 import os
 import subprocess
 import sys

@@ -2,6 +2,12 @@
 the prefetching pipeline's exact-resume contract."""
 
 from __future__ import annotations
+import pytest as _pytest_mark  # noqa: E402
+
+# Sub-2-minute smoke tier (COVERAGE.md "Test tiers"): this module's
+# measured wall time keeps `pytest -m fast` under the tier budget.
+pytestmark = _pytest_mark.mark.fast
+
 
 import numpy as np
 import pytest

@@ -1,6 +1,12 @@
 """Optimizer/schedule factory extras (SURVEY C3): lion and the WSD
 schedule behave as specified."""
 
+
+import pytest as _pytest_mark  # noqa: E402
+
+# Sub-2-minute smoke tier (COVERAGE.md "Test tiers"): this module's
+# measured wall time keeps `pytest -m fast` under the tier budget.
+pytestmark = _pytest_mark.mark.fast
 import jax
 import numpy as np
 

@@ -1,6 +1,12 @@
 """Simulated-distributed tier (SURVEY §4): every strategy must (i) match the
 single-device run numerically and (ii) produce the expected shardings."""
 
+
+import pytest as _pytest_mark  # noqa: E402
+
+# Sub-2-minute smoke tier (COVERAGE.md "Test tiers"): this module's
+# measured wall time keeps `pytest -m fast` under the tier budget.
+pytestmark = _pytest_mark.mark.fast
 import jax
 import jax.numpy as jnp
 import numpy as np

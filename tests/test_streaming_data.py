@@ -3,6 +3,12 @@ widens its sampling window while producers keep sealing new shards —
 reference parity with torch's streaming DataLoader, expressed as an
 append-only shard watermark (data/streaming.py)."""
 
+
+import pytest as _pytest_mark  # noqa: E402
+
+# Sub-2-minute smoke tier (COVERAGE.md "Test tiers"): this module's
+# measured wall time keeps `pytest -m fast` under the tier budget.
+pytestmark = _pytest_mark.mark.fast
 import os
 
 import numpy as np
@@ -61,7 +67,7 @@ def test_streaming_corpus_widens_and_freezes_between_refreshes(tmp_path):
     _write_shard(d, 0)
     corpus = StreamingShardCorpus(d, "train", "images", refresh_every=10)
     assert corpus.found and corpus.n == 8
-    assert corpus.state() == {"shards": 1, "items": 8}
+    assert corpus.state() == {"shards": 1, "items": 8, "skew_deferrals": 0}
 
     _write_shard(d, 1)
     # Before the refresh step the view is FROZEN (determinism contract).
@@ -70,7 +76,7 @@ def test_streaming_corpus_widens_and_freezes_between_refreshes(tmp_path):
     # At/after the refresh boundary the window widens to the new shard.
     corpus.maybe_refresh(10)
     assert corpus.n == 16
-    assert corpus.state() == {"shards": 2, "items": 16}
+    assert corpus.state() == {"shards": 2, "items": 16, "skew_deferrals": 0}
     # New items are actually reachable, with their own labels.
     x, y = corpus.gather(np.arange(8, 16))
     assert x.shape == (8, 8, 8, 3)
@@ -130,6 +136,151 @@ def test_streaming_multihost_window_protocol(tmp_path, monkeypatch):
     assert leader.n == 16
 
 
+def test_streaming_retry_within_bucket_and_skew_counter(tmp_path):
+    """An agreed window this host can't serve yet must RETRY on the next
+    batch (not defer a whole refresh bucket — the window is already active
+    on peers, so every deferred step skews the DP data distribution), and
+    the lag must be observable via the ``skew_deferrals`` watermark."""
+    d = str(tmp_path)
+    _write_shard(d, 0)
+    corpus = StreamingShardCorpus(d, "train", "images", refresh_every=10)
+
+    # Simulate the leader having activated a 2-shard window that this
+    # host's filesystem view does not serve yet (NFS attribute-cache lag).
+    real_agree = corpus._proto.agree
+    corpus._proto.agree = lambda bucket: (2, 0)
+    corpus.maybe_refresh(10)
+    assert corpus.n == 8  # not adopted
+    assert corpus.state()["skew_deferrals"] == 1
+    # The retry happens on the NEXT batch, within the same bucket.
+    assert corpus._next_refresh == 11
+    corpus.maybe_refresh(11)
+    assert corpus.state()["skew_deferrals"] == 2
+
+    # The lagging shard lands: the very next batch adopts — no waiting
+    # for bucket 2 — and the schedule returns to the bucket boundary.
+    _write_shard(d, 1)
+    corpus.maybe_refresh(12)
+    assert corpus.n == 16
+    assert corpus.state()["skew_deferrals"] == 2
+    assert corpus._next_refresh == 20
+    corpus._proto.agree = real_agree
+
+
+def test_streaming_initial_rejects_stale_anchor_window(tmp_path, monkeypatch):
+    """A ``.stream_sync`` window file left by an EARLIER corpus in the same
+    directory (different anchor) must not be adopted at construction —
+    its counts index a different shard SET. The protocol keeps waiting for
+    a window matching the local anchor and fails loudly at the deadline."""
+    import json
+
+    import jax
+
+    d = str(tmp_path)
+    # Current corpus anchors at shard 3 (earlier shards were rotated out).
+    _write_shard(d, 3)
+    _write_shard(d, 4)
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 1)  # follower: no
+    # leader process exists to replace the stale window in this test.
+    os.makedirs(os.path.join(d, ".stream_sync"), exist_ok=True)
+    with open(
+        os.path.join(d, ".stream_sync", "train_images_window.json"), "w"
+    ) as fh:
+        json.dump({"count": 9, "anchor": 0, "activate_at_bucket": 0}, fh)
+    with pytest.raises(ValueError, match="no agreed initial window"):
+        corpus = StreamingShardCorpus.__new__(StreamingShardCorpus)
+        corpus.data_dir, corpus.split, corpus.kind = d, "train", "images"
+        corpus.refresh_every = 4
+        from frl_distributed_ml_scaffold_tpu.data.streaming import (
+            _WindowProtocol,
+        )
+
+        corpus._proto = _WindowProtocol(
+            d, "train_images", corpus._local_scan
+        )
+        corpus._proto.initial(deadline_s=2.5)
+
+
+def test_streaming_retry_budget_caps_per_batch_scans(tmp_path):
+    """A PERMANENTLY unservable window (rotated corpus mid-run) must not
+    pay a directory scan + sync publish + warning on every batch forever:
+    after the per-bucket retry budget, adoption defers to the next bucket
+    boundary."""
+    from frl_distributed_ml_scaffold_tpu.data import streaming
+
+    d = str(tmp_path)
+    _write_shard(d, 0)
+    corpus = StreamingShardCorpus(d, "train", "images", refresh_every=100)
+    corpus._proto.agree = lambda bucket: (5, 0)  # never servable locally
+    step = 100
+    for _ in range(streaming.RETRY_BUDGET_PER_BUCKET):
+        corpus.maybe_refresh(step)
+        assert corpus._next_refresh == step + 1  # retrying next batch
+        step = corpus._next_refresh
+    corpus.maybe_refresh(step)
+    assert corpus._next_refresh == 200, "budget spent: defer to boundary"
+    assert corpus.state()["skew_deferrals"] == (
+        streaming.RETRY_BUDGET_PER_BUCKET + 1
+    )
+    # Fresh bucket, fresh budget.
+    corpus.maybe_refresh(200)
+    assert corpus._next_refresh == 201
+
+
+def test_streaming_leader_repairs_stale_anchor_window(tmp_path, monkeypatch):
+    """The LEADER must overwrite a leftover different-anchor window (its
+    count is incomparable with the current corpus) once every live host
+    has published the new anchor — otherwise the followers' anchor guard
+    would spin to the deadline on a state the leader could repair."""
+    import json
+
+    import jax
+
+    d = str(tmp_path)
+    _write_shard(d, 3)  # current corpus anchors at 3
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    os.makedirs(os.path.join(d, ".stream_sync"), exist_ok=True)
+    with open(
+        os.path.join(d, ".stream_sync", "train_images_window.json"), "w"
+    ) as fh:
+        json.dump({"count": 9, "anchor": 0, "activate_at_bucket": 0}, fh)
+    with open(
+        os.path.join(d, ".stream_sync", "train_images_host_1.json"), "w"
+    ) as fh:
+        json.dump({"count": 1, "anchor": 3}, fh)
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    leader = StreamingShardCorpus(d, "train", "images", refresh_every=4)
+    assert leader.n == 8
+    win = json.load(
+        open(os.path.join(d, ".stream_sync", "train_images_window.json"))
+    )
+    assert win["anchor"] == 3 and win["count"] == 1
+
+
+def test_streaming_initial_accepts_matching_anchor_window(tmp_path,
+                                                          monkeypatch):
+    """Control for the stale-anchor guard: a same-anchor window from an
+    earlier run IS servable (append-only corpus) and must still be adopted
+    without waiting for a live leader."""
+    import json
+
+    import jax
+
+    d = str(tmp_path)
+    _write_shard(d, 0)
+    _write_shard(d, 1)
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    os.makedirs(os.path.join(d, ".stream_sync"), exist_ok=True)
+    with open(
+        os.path.join(d, ".stream_sync", "train_images_window.json"), "w"
+    ) as fh:
+        json.dump({"count": 1, "anchor": 0, "activate_at_bucket": 0}, fh)
+    corpus = StreamingShardCorpus(d, "train", "images", refresh_every=4)
+    assert corpus.n == 8  # the 1-shard window from the previous run
+
+
 def test_streaming_token_bin_grows(tmp_path):
     """The LM tier's online ingestion: a tokenizer keeps APPENDING to
     {split}.bin; the loader's visible window widens (rounded down to
@@ -156,7 +307,7 @@ def test_streaming_token_bin_grows(tmp_path):
     assert len(tb) == TOKEN_BLOCK  # frozen between refreshes
     tb.maybe_refresh(10)
     assert len(tb) == 3 * TOKEN_BLOCK
-    assert tb.state() == {"tokens": 3 * TOKEN_BLOCK}
+    assert tb.state() == {"tokens": 3 * TOKEN_BLOCK, "skew_deferrals": 0}
 
     # The appender must refuse ids that don't fit the pinned dtype/vocab.
     with pytest.raises(ValueError, match="vocab_size"):

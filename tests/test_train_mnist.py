@@ -1,6 +1,12 @@
 """End-to-end slice (SURVEY §7 stage 2 / §4 integration): MLP on (synthetic)
 MNIST through the full launcher→config→data→step→metrics path."""
 
+
+import pytest as _pytest_mark  # noqa: E402
+
+# Sub-2-minute smoke tier (COVERAGE.md "Test tiers"): this module's
+# measured wall time keeps `pytest -m fast` under the tier budget.
+pytestmark = _pytest_mark.mark.fast
 import sys
 
 import numpy as np

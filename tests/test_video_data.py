@@ -1,6 +1,12 @@
 """Video clip-shard loader (SURVEY C16 'Ego4D clip loaders'): producer/
 consumer round trip, determinism, config-shape validation, fallback."""
 
+
+import pytest as _pytest_mark  # noqa: E402
+
+# Sub-2-minute smoke tier (COVERAGE.md "Test tiers"): this module's
+# measured wall time keeps `pytest -m fast` under the tier budget.
+pytestmark = _pytest_mark.mark.fast
 import numpy as np
 import pytest
 

@@ -125,10 +125,13 @@ def main() -> int:
             sharding, local, (per_chip * n,)
         )
         for name, (fn, out_specs, mult) in OPS.items():
+            from frl_distributed_ml_scaffold_tpu.dist.mesh import (
+                shard_map_compat,
+            )
+
             smfn = jax.jit(
-                jax.shard_map(
+                shard_map_compat(
                     fn, mesh=mesh, in_specs=P(axis), out_specs=out_specs,
-                    check_vma=False,
                 )
             )
             try:

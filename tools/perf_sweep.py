@@ -355,11 +355,29 @@ def rn50_fused_opt():
         emit("rn50_fused_opt", 512, dt, {"optimizer": opt})
 
 
+def rn50_fused_bn():
+    """The priced HBM-ceiling fix, bought (BACKLOG R5-4): the roofline
+    pins ~150 ms of the 227 ms headline step in BN-backward HBM traffic
+    (docs/perf_playbook.md); A/B the fused two-pass Pallas BN backward
+    (ops/fused_bn.py, model.fused_bn) against the autodiff reference at
+    the exact headline operating point. Long windows: the delta at stake
+    is ~15-20% of step time, but per-window noise on the relay is ~1%."""
+    for fused in ("false", "true"):
+        dt = measure(
+            "imagenet_rn50_ddp",
+            ["data.global_batch_size=512", "model.stem=s2d",
+             f"model.fused_bn={fused}"],
+            n=30, warm=4,
+        )
+        emit("rn50_fused_bn", 512, dt, {"fused_bn": fused})
+
+
 GROUPS = {f.__name__: f for f in (rn50_bs, rn50_precision, rn50_fwd_only,
                                   rn50_depth, rn50_stem, rn50_split, vitb,
                                   rn50_headline, rn50_pool, gpt2_opt,
                                   gpt2_block_remat, gpt2_offload,
-                                  rn50_fused_opt, moe_dispatch)}
+                                  rn50_fused_opt, rn50_fused_bn,
+                                  moe_dispatch)}
 
 if __name__ == "__main__":
     which = sys.argv[1:] or list(GROUPS)
