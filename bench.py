@@ -141,6 +141,27 @@ def _table_rows(config: str):
             yield row
 
 
+def _row_captured_at(row: dict) -> str | None:
+    """Capture-time provenance of a protocol row, best evidence first:
+    the explicit ``captured_at`` stamp (written by protocol_record since
+    round 6), else a date parsed out of the row's ``source``/``note``
+    free text (the round-4/5 rows record e.g. "captured 2026-07-30
+    ~21:26 UTC" there). None only for rows with no provenance at all —
+    the case the tier-2 "unknown time" log line is reserved for."""
+    ts = row.get("captured_at")
+    if isinstance(ts, str) and ts:
+        return ts
+    import re
+
+    text = f"{row.get('source', '')} {row.get('note', '')}"
+    m = re.search(r"(\d{4}-\d{2}-\d{2})(?:\s*~?(\d{1,2}):(\d{2}))?", text)
+    if m is None:
+        return None
+    if m.group(2):
+        return f"{m.group(1)}T{int(m.group(2)):02d}:{m.group(3)}:00Z"
+    return f"{m.group(1)}T00:00:00Z"
+
+
 def _table_fallback_record() -> dict | None:
     """Second-tier stale source: reconstruct the headline record from
     BENCH_TABLE.jsonl's own protocol row (committed evidence, written
@@ -168,6 +189,9 @@ def _table_fallback_record() -> dict | None:
         }
         if "mfu" in row:
             rec["mfu"] = row["mfu"]
+        ts = _row_captured_at(row)
+        if ts:
+            rec["captured_at"] = ts
         return rec
     except Exception:
         return None
@@ -287,6 +311,10 @@ def protocol_record(cfg, trainer, perf, *, step_flops: float = 0.0) -> dict:
         "samples_per_sec_per_chip": round(perf["samples_per_sec_per_chip"], 2),
         "step_time_median_s": round(perf["step_time_median_s"], 6),
         "step_time_p90_s": round(perf["step_time_p90_s"], 6),
+        # Capture-time provenance travels WITH the row: the stale-fallback
+        # tiers re-emit it so an outage record always says when its
+        # numbers were real (satellite of the round-6 provenance fix).
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
     from frl_distributed_ml_scaffold_tpu.utils.profiling import device_memory_stats
 

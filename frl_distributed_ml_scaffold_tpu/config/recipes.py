@@ -155,7 +155,15 @@ def gpt2_medium_adafactor() -> ExperimentConfig:
     in 300 steps); at its conventional 1e-2 it beats adamw's final loss
     outright (0.83 vs 4.07 on the proxy task; 3e-2 measured better still
     on the proxy, 1e-2 kept for scale-stability convention, T5/PaLM
-    practice). The BASELINE-faithful recipe keeps adamw (reference
+    practice). De-risked at scale round 6 (ISSUE r6: the 0.48M proxy was
+    judged too small to pin a recipe LR): the SAME grid at a 10.34M-param
+    proxy for 1000 steps — evidence_r6/opt_convergence_10m.log, pinned by
+    test_adafactor_recipe_lr_at_10m_proxy — confirms 1e-2 from both
+    sides of the bracket: adafactor@1e-2 0.7274 final loss vs adamw@3e-4
+    0.8519 (wins outright at scale too), while 3e-3 under-trains (2.68)
+    and 3e-2 ties (0.7342) — at 10M params 1e-2 is already the optimum,
+    not just the stability-conservative pick.
+    The BASELINE-faithful recipe keeps adamw (reference
     config 4 parity); this variant is the recorded recipe-level decision
     for throughput-first runs. ZeRO-1 is redundant under adafactor's
     factored state, so opt_sharding stays for parity of comparison only.
@@ -166,6 +174,30 @@ def gpt2_medium_adafactor() -> ExperimentConfig:
         optimizer=dataclasses.replace(
             base.optimizer, name="adafactor", learning_rate=1e-2,
             weight_decay=0.0,
+        ),
+    )
+
+
+@register_config("gpt2_medium_fsdp_overlap")
+def gpt2_medium_fsdp_overlap() -> ExperimentConfig:
+    """Flagship LM under overlap-scheduled FSDP (parallel/fsdp_overlap.py):
+    params full-sharded over ``fsdp`` with EXPLICIT per-block all-gather /
+    reduce-scatter and one-block-ahead prefetch, instead of GSPMD's
+    gather-up-front schedule. The sweep config for the on-chip A/B
+    (tools/perf_sweep.py gpt2_fsdp_overlap, queued in BACKLOG): same
+    operating point as the gpt2_medium_zero1 protocol row so the step-time
+    delta reads as the scheduling win alone. Correctness is sim-gated in
+    tests/test_fsdp_overlap.py (numerics vs the GSPMD FSDP path, blockwise
+    gather jaxpr assertion, mesh compositions)."""
+    base = gpt2_medium_zero1()
+    return base.replace(
+        name="gpt2_medium_fsdp_overlap",
+        mesh=MeshConfig(data=1, fsdp=-1),
+        parallel=ParallelConfig(
+            param_sharding="fsdp",
+            opt_sharding="like_params",  # opt state inherits the fsdp shards
+            fsdp_overlap=True,
+            fsdp_prefetch=1,
         ),
     )
 

@@ -71,12 +71,28 @@ class ParallelConfig:
     - ``sequence``: "none" | "ring" | "ulysses" — long-context attention mode.
     - ``fsdp_min_size``: leaves smaller than this stay replicated (sharding
       tiny params costs more collective latency than it saves memory).
+    - ``fsdp_overlap``: opt-in overlap-scheduled FSDP (SimpleFSDP-style,
+      arxiv 2411.00284): instead of leaving parameter gathering to GSPMD
+      (which tends to materialize full params up front and serialize the
+      collectives against compute), each transformer block / ResNet block
+      explicitly ``all_gather``s its shard immediately before its compute
+      and the backward ``reduce_scatter``s gradients straight back into
+      shards (parallel/fsdp_overlap.py). Requires ``param_sharding="fsdp"``
+      and a model family with blockwise apply hooks (gpt, resnet).
+    - ``fsdp_prefetch``: how many blocks ahead a gather may be issued
+      (default 1 — the SimpleFSDP "one block ahead" schedule). On the
+      per-block Python loop (ResNet) the window is enforced structurally
+      with optimization barriers; on the scanned transformer stack the
+      rolled loop exposes exactly one block of lookahead to XLA's
+      collective pipeliner, so values > 1 behave as 1 there.
     """
 
     param_sharding: str = "replicated"  # replicated | fsdp
     opt_sharding: str = "like_params"  # like_params | zero1
     sequence: str = "none"  # none | ring | ulysses
     fsdp_min_size: int = 1024
+    fsdp_overlap: bool = False
+    fsdp_prefetch: int = 1
 
 
 @dataclass(frozen=True)

@@ -256,6 +256,15 @@ class Block(nn.Module):
 class GPT(nn.Module):
     config: GPTConfig
     policy: Policy
+    # Overlap-scheduled FSDP blockwise apply hook (parallel/fsdp_overlap.py
+    # OverlapHooks): when set, each scanned Block's param slice is
+    # explicitly all-gathered inside the scan body (nn.map_variables) and
+    # the block is rematted with a policy that refuses to save the gathered
+    # full params, so the backward re-gathers (reduce-scatter of grads is
+    # the gather's transpose). Attached by the Trainer AFTER partition
+    # specs exist; init/decode always run unhooked — the params tree is
+    # identical either way.
+    param_hooks: Any = None
 
     @nn.compact
     def __call__(
@@ -330,7 +339,21 @@ class GPT(nn.Module):
             x, aux_loss = pipe(x, jnp.zeros((), jnp.float32))
         else:
             block_cls = Block
-            if cfg.block_remat != "none" and not decode:
+            hooks = self.param_hooks if not decode else None
+            if hooks is not None:
+                # Gather INSIDE the scan body (one layer's slice per
+                # iteration — the blockwise schedule) and inside the remat
+                # region below (so recompute re-gathers instead of saving
+                # full params). map_variables(init=False): param creation
+                # still sees the raw sharded tree, keeping init and
+                # checkpoint layouts identical to the unhooked model.
+                block_cls = nn.map_variables(
+                    block_cls,
+                    "params",
+                    trans_in_fn=hooks.block_hook,
+                    init=False,
+                )
+            if (cfg.block_remat != "none" or hooks is not None) and not decode:
                 # Per-layer remat (config 3's activation checkpointing at
                 # the granularity that matters under nn.scan): checkpoint
                 # each scanned body so the backward re-derives one block's
@@ -339,7 +362,15 @@ class GPT(nn.Module):
                 # the scan boundary already stops the CSE that remat's
                 # default guards against, and leaving it True blocks XLA
                 # optimizations for nothing.
-                if cfg.block_remat == "full":
+                if hooks is not None:
+                    # Same three modes, with gathered params always
+                    # excluded from the saved set (GATHER_NAME tag).
+                    from frl_distributed_ml_scaffold_tpu.parallel.fsdp_overlap import (
+                        overlap_remat_policy,
+                    )
+
+                    policy = overlap_remat_policy(cfg.block_remat)
+                elif cfg.block_remat == "full":
                     policy = None
                 elif cfg.block_remat == "save_attn":
                     policy = jax.checkpoint_policies.save_only_these_names(
@@ -350,7 +381,7 @@ class GPT(nn.Module):
                         f"unknown model.block_remat={cfg.block_remat!r} "
                         "(none | full | save_attn)"
                     )
-                block_cls = nn.remat(Block, prevent_cse=False, policy=policy)
+                block_cls = nn.remat(block_cls, prevent_cse=False, policy=policy)
             blocks = nn.scan(
                 block_cls,
                 length=cfg.num_layers,

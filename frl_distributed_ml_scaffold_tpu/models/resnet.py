@@ -216,6 +216,14 @@ class BasicBlock(nn.Module):
 class ResNet(nn.Module):
     config: ResNetConfig
     policy: Policy
+    # Overlap-scheduled FSDP blockwise apply hook (parallel/fsdp_overlap.py
+    # OverlapHooks): when set, each residual block's params are explicitly
+    # all-gathered immediately before that block's compute — and the gather
+    # of block k is tied (optimization_barrier) to the output of block
+    # k - 1 - prefetch, which is the structurally enforced prefetch window
+    # of the SimpleFSDP schedule. Attached by the Trainer; init always
+    # runs unhooked, and the params tree is identical either way.
+    param_hooks: Any = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
@@ -272,14 +280,47 @@ class ResNet(nn.Module):
             )
 
         block_cls = BottleneckBlock if BOTTLENECK[cfg.depth] else BasicBlock
+        hooks = self.param_hooks
+        if hooks is not None:
+            from frl_distributed_ml_scaffold_tpu.parallel.fsdp_overlap import (
+                overlap_remat_policy,
+            )
+
+            remat_policy = overlap_remat_policy("none")
+        outs: list[jnp.ndarray] = []
         for stage, n_blocks in enumerate(STAGE_SIZES[cfg.depth]):
             for block in range(n_blocks):
-                x = block_cls(
+                cls = block_cls
+                kw = {}
+                if hooks is not None:
+                    # Prefetch window: block k's gather may issue only
+                    # after block k-1-prefetch finishes — under it, the
+                    # next gather runs while `prefetch` blocks compute.
+                    k = len(outs)
+                    tok_i = k - 1 - hooks.prefetch
+                    token = outs[tok_i] if tok_i >= 0 else None
+                    cls = nn.map_variables(
+                        cls,
+                        "params",
+                        trans_in_fn=hooks.hook_factory(token),
+                        init=False,
+                    )
+                    # Remat with the except-gathered policy: backward
+                    # re-gathers instead of keeping full block params
+                    # among the residuals.
+                    cls = nn.remat(cls, prevent_cse=False, policy=remat_policy)
+                    # Lifted transforms mangle auto-names; pin the name the
+                    # UNhooked path would auto-assign so the param tree is
+                    # layout-identical with hooks on or off.
+                    kw["name"] = f"{block_cls.__name__}_{k}"
+                x = cls(
                     filters=64 * cfg.width_multiplier * (2**stage),
                     strides=2 if (block == 0 and stage > 0) else 1,
                     conv=conv,
                     norm=norm,
+                    **kw,
                 )(x)
+                outs.append(x)
 
         x = jnp.mean(x, axis=(1, 2))  # global average pool
         x = nn.Dense(cfg.num_classes, dtype=dtype)(x)

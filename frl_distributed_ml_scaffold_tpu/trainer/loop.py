@@ -118,6 +118,12 @@ class Trainer:
                 "opt_sharding=like_params) on a mesh with model=1, "
                 "expert=1 and pipe=1; use adamw for sharded-state configs"
             )
+        if cfg.parallel.fsdp_overlap:
+            from frl_distributed_ml_scaffold_tpu.parallel.fsdp_overlap import (
+                validate_overlap_config,
+            )
+
+            validate_overlap_config(cfg)
         self.env = mesh_env if mesh_env is not None else build_mesh(cfg.mesh)
         self.policy = get_policy(cfg.precision)
         self.model = create_model(cfg.model, self.policy)
@@ -136,6 +142,11 @@ class Trainer:
             )
 
         self._build_state_shardings()
+        if cfg.parallel.fsdp_overlap:
+            # Hooks need the partition specs, so they attach only after
+            # the (unhooked) model produced the state shapes above; the
+            # params tree is identical with hooks on or off.
+            self._attach_overlap_hooks()
         self._compile_steps()
 
     # ---------------------------------------------------------------- setup
@@ -211,6 +222,46 @@ class Trainer:
             )
         self.state_shapes = state_shapes
         self._rng = rng
+
+    def _attach_overlap_hooks(self) -> None:
+        """Rebind the model + loss_fn to the overlap-scheduled FSDP path
+        (parallel/fsdp_overlap.py): explicit per-block all-gather of
+        sharded params / reduce-scatter of grads, prefetched one block
+        ahead. Requires the partition specs from _build_state_shardings."""
+        from jax.sharding import PartitionSpec as P
+
+        from frl_distributed_ml_scaffold_tpu.parallel.fsdp_overlap import (
+            OverlapHooks,
+            make_scan_block_hook,
+            make_shape_hook_factory,
+            strip_scan_dim,
+        )
+
+        cfg = self.cfg
+        prefetch = cfg.parallel.fsdp_prefetch
+        if cfg.model.family == "gpt":
+            # The scanned stack's hook gathers one layer's SLICE per scan
+            # iteration; its specs are the stacked specs minus the layer dim.
+            sliced = jax.tree.map(
+                strip_scan_dim,
+                self.state_specs.params["blocks"],
+                is_leaf=lambda t: isinstance(t, P),
+            )
+            hooks = OverlapHooks(
+                prefetch=prefetch, block_hook=make_scan_block_hook(sliced)
+            )
+        else:  # resnet (validate_overlap_config gates the families)
+            hooks = OverlapHooks(
+                prefetch=prefetch,
+                hook_factory=make_shape_hook_factory(
+                    cfg.parallel, self.env.axis_size("fsdp")
+                ),
+            )
+        # Hooked clone for APPLY only (train/eval loss): map_variables
+        # cannot create params, so init/eval_shape keep the plain
+        # self.model — the params tree is identical either way.
+        self._overlap_model = self.model.clone(param_hooks=hooks)
+        self.loss_fn = make_loss_fn(self._overlap_model, cfg.data.name)
 
     def _mesh_scoped(self, fn):
         """Run ``fn`` with this trainer's mesh as the ambient context.
@@ -337,6 +388,14 @@ class Trainer:
             remat=cfg.trainer.remat,
             ema_decay=cfg.trainer.ema_decay,
             offload_opt_state=cfg.trainer.offload_opt_state,
+            # FSDP: pin the grad-accum accumulator to the params' sharded
+            # layout, so microbatch grads accumulate as SHARDS (post
+            # reduce-scatter), never as gathered full-model fp32 tensors.
+            grad_shardings=(
+                self.state_shardings.params
+                if cfg.parallel.param_sharding == "fsdp"
+                else None
+            ),
         )
         # Batch shardings are inferred from the example batch structure.
         example = example_input(cfg.data, cfg.model, batch_size=self.env.batch_axis_size)

@@ -63,6 +63,7 @@ def make_train_step(
     remat: str = "none",
     ema_decay: float = 0.0,
     offload_opt_state: bool = False,
+    grad_shardings: Any = None,
 ) -> Callable[[TrainState, Any], tuple[TrainState, dict[str, jax.Array]]]:
     """Build the (unjitted) step function; the Trainer jits it with shardings.
 
@@ -92,6 +93,16 @@ def make_train_step(
         zero_grads = jax.tree.map(
             lambda p: jnp.zeros(p.shape, policy.reduce_dtype), params_c
         )
+        if grad_shardings is not None:
+            # Anchor the fp32 accumulator in the PARAMS' (sharded) layout:
+            # under FSDP the per-microbatch grads come out of the backward
+            # as shards (reduce-scatter is the gather's transpose), and an
+            # unconstrained scan carry would let the partitioner pick a
+            # replicated accumulator — i.e. accumulate GATHERED grads,
+            # re-materializing full-model-sized fp32 state every step.
+            zero_grads = jax.lax.with_sharding_constraint(
+                zero_grads, grad_shardings
+            )
         first_micro = jax.tree.map(lambda x: x[0], micro)
         metrics_shape = jax.eval_shape(
             lambda: wrapped(params_c, extras, first_micro, rngs[0], True)[1][0]

@@ -58,8 +58,54 @@ jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 # eager RN50 init vs <1s as one cached program). Jitting the hot test
 # bodies cut the warm suite 394s -> 255s at identical coverage.
 
+# Evidence-cache sandbox, SESSION-WIDE (round-6 hardening of the round-5
+# self-poisoning fix): bench.py's last-good cache path is env-overridable,
+# and test_bench.py monkeypatches its own module object — but any OTHER
+# test that imports bench (or launches a subprocess that does) would still
+# write the COMMITTED bench_last_good.json. Exporting the override here,
+# before any test imports bench, covers every reacher in one place;
+# setdefault keeps an operator's explicit override authoritative.
+import tempfile  # noqa: E402
+
+os.environ.setdefault(
+    "FRL_BENCH_LAST_GOOD_PATH",
+    os.path.join(
+        tempfile.gettempdir(), f"frl_bench_last_good_sandbox_{os.getpid()}.json"
+    ),
+)
+
 import contextlib  # noqa: E402
 import logging  # noqa: E402
+import pytest  # noqa: E402
+
+_REPO_BENCH_CACHE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "bench_last_good.json",
+)
+
+
+@pytest.fixture(scope="session", autouse=True)
+def committed_bench_cache_stays_byte_identical():
+    """The committed evidence cache must survive a FULL suite run
+    byte-identical (ISSUE r6 satellite; the round-5 bug was every pytest
+    run stamping fixture value 123.0 into it). Asserting at session
+    teardown catches any write path the env sandbox above misses."""
+    before = (
+        open(_REPO_BENCH_CACHE, "rb").read()
+        if os.path.exists(_REPO_BENCH_CACHE)
+        else None
+    )
+    yield
+    after = (
+        open(_REPO_BENCH_CACHE, "rb").read()
+        if os.path.exists(_REPO_BENCH_CACHE)
+        else None
+    )
+    assert before == after, (
+        "the test suite modified the committed bench_last_good.json — "
+        "some _save_last_good/_emit_stale_or_error reacher is not covered "
+        "by the FRL_BENCH_LAST_GOOD_PATH sandbox"
+    )
 
 
 @contextlib.contextmanager

@@ -60,6 +60,105 @@ def test_save_last_good_writes_sandbox_not_repo(sandbox_last_good):
         )
 
 
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_session_sandbox_env_is_active():
+    """conftest.py must export the session-wide cache sandbox BEFORE any
+    test imports bench — the committed evidence file is then unreachable
+    even from tests (and subprocesses) outside this module."""
+    sandbox = os.environ.get("FRL_BENCH_LAST_GOOD_PATH")
+    assert sandbox, "conftest session sandbox env var missing"
+    assert os.path.abspath(sandbox) != os.path.join(
+        REPO_ROOT, "bench_last_good.json"
+    )
+    assert not os.path.abspath(sandbox).startswith(REPO_ROOT + os.sep)
+
+
+def test_committed_cache_is_corroborated(monkeypatch):
+    """The acceptance gate: the committed bench_last_good.json must carry
+    the real protocol-row capture (2256.04) and pass _corroborated against
+    the committed BENCH_TABLE.jsonl, so the tier-1 stale fallback can
+    actually fire with real data after a relay outage."""
+    committed = os.path.join(REPO_ROOT, "bench_last_good.json")
+    rec = json.load(open(committed))
+    # _corroborated derives the table path from LAST_GOOD_PATH's dirname;
+    # point it at the repo READ-ONLY (no write path runs here).
+    monkeypatch.setattr(bench, "LAST_GOOD_PATH", committed)
+    assert bench._corroborated(rec), rec
+    assert rec["value"] != 123.0, "test-fixture value in the committed cache"
+    import re
+
+    assert re.match(r"\d{4}-\d{2}-\d{2}T", rec.get("captured_at", "")), rec
+
+
+def test_bench_table_rows_meet_protocol_schema():
+    """Every committed protocol row must carry the full measurement
+    context: mesh, per-sample FLOPs and MFU (BASELINE.md protocol), plus
+    capture provenance — incomplete rows can't back the stale fallback."""
+    table = os.path.join(REPO_ROOT, "BENCH_TABLE.jsonl")
+    rows = [json.loads(l) for l in open(table).read().splitlines() if l.strip()]
+    assert rows, "committed BENCH_TABLE.jsonl is empty"
+    for row in rows:
+        ctx = f"row for {row.get('config')}"
+        for key in ("config", "samples_per_sec_per_chip", "mesh",
+                    "model_flops_per_sample", "mfu"):
+            assert key in row, f"{ctx} missing {key}"
+        assert isinstance(row["mesh"], dict) and row["mesh"], ctx
+        assert row["model_flops_per_sample"] > 0, ctx
+        assert 0 < row["mfu"] < 1.0, ctx
+        assert bench._row_captured_at(row), f"{ctx} has no capture provenance"
+
+
+def test_stale_fallback_tier1_carries_captured_at(
+    sandbox_last_good, monkeypatch, capsys
+):
+    """Simulated outage, tier 1 (cache present): the re-emitted record
+    must carry a real captured_at, not 'unknown time'."""
+    rec = {
+        "metric": "rn50_imagenet_samples_per_sec_per_chip",
+        "value": 2256.04, "unit": "samples/sec/chip", "vs_baseline": 0.9,
+        "captured_at": "2026-07-30T00:00:00Z",
+    }
+    sandbox_last_good.write_text(json.dumps(rec))
+    (sandbox_last_good.parent / "BENCH_TABLE.jsonl").write_text(
+        json.dumps({"config": "imagenet_rn50_ddp",
+                    "samples_per_sec_per_chip": 2256.04}) + "\n"
+    )
+    rc = bench._emit_stale_or_error("relay down (simulated)")
+    assert rc == 1
+    out = [l for l in capsys.readouterr().out.splitlines() if l.startswith("{")]
+    final = json.loads(out[-1])
+    assert final["stale"] is True
+    assert final["captured_at"] == "2026-07-30T00:00:00Z"
+
+
+def test_stale_fallback_tier2_parses_captured_at_from_table_row(
+    sandbox_last_good, monkeypatch, capsys
+):
+    """Simulated outage, tier 2 (no cache — reconstruct from the protocol
+    table): captured_at must be parsed out of the row (explicit field or
+    the source free text), so tier 2 no longer logs 'unknown time'."""
+    assert not sandbox_last_good.exists()
+    (sandbox_last_good.parent / "BENCH_TABLE.jsonl").write_text(
+        json.dumps({
+            "config": "imagenet_rn50_ddp",
+            "samples_per_sec_per_chip": 2256.04, "mfu": 0.3233,
+            "chip": "TPU v5 lite",
+            "source": "evidence log, captured 2026-07-30 ~21:26 UTC",
+        }) + "\n"
+    )
+    rc = bench._emit_stale_or_error("relay down (simulated)")
+    assert rc == 1
+    captured = capsys.readouterr()
+    out = [l for l in captured.out.splitlines() if l.startswith("{")]
+    final = json.loads(out[-1])
+    assert final["stale"] is True
+    assert final["value"] == 2256.04
+    assert final["captured_at"] == "2026-07-30T21:26:00Z"
+    assert "unknown time" not in captured.err
+
+
 def test_bench_config_emits_protocol_record():
     perf = bench.bench_config(
         "mnist_mlp",

@@ -1,0 +1,347 @@
+"""Overlap-scheduled FSDP (parallel/fsdp_overlap.py): the explicit
+blockwise all-gather / reduce-scatter schedule must (i) match the plain
+GSPMD FSDP path numerically on every mesh composition, (ii) gather
+BLOCKWISE — one layer's slice inside the scan body, never the stacked
+full-model tensor — and (iii) refuse configs it cannot honor."""
+
+# NOT in the `fast` tier: this module is a multi-mesh numerics grid
+# (~50 s warm), which the tier's selection rule keeps out by design —
+# same category as the pipeline equivalence grids (COVERAGE.md).
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from frl_distributed_ml_scaffold_tpu.config import apply_overrides, get_config
+from frl_distributed_ml_scaffold_tpu.dist.mesh import build_mesh, mesh_context
+from frl_distributed_ml_scaffold_tpu.trainer.loop import Trainer
+
+GPT_TINY = [
+    "model.num_layers=2", "model.num_heads=4", "model.hidden_dim=64",
+    "model.seq_len=64", "model.vocab_size=256",
+    "data.seq_len=64", "data.vocab_size=256",
+    "data.global_batch_size=16",
+    "trainer.grad_accum=1", "trainer.remat=none",
+    "trainer.log_every=1000000",
+    "precision.policy=fp32",
+    "checkpoint.enabled=false",
+    "optimizer.warmup_steps=0",
+    "parallel.fsdp_min_size=16",
+]
+
+RN_TINY = [
+    "model.depth=10", "model.num_classes=10",
+    "data.name=synthetic_imagenet", "data.image_size=32",
+    "data.num_classes=10", "data.global_batch_size=16",
+    "trainer.grad_accum=1", "trainer.remat=none",
+    "trainer.log_every=1000000",
+    "precision.policy=fp32",
+    "optimizer.name=sgd", "optimizer.learning_rate=0.01",
+    "optimizer.warmup_steps=0",
+    "checkpoint.enabled=false",
+    "parallel.fsdp_min_size=16",
+]
+
+FSDP = ["parallel.param_sharding=fsdp", "parallel.opt_sharding=like_params"]
+
+
+def make_trainer(name, base, overrides, tmp_path):
+    cfg = apply_overrides(
+        get_config(name), base + [f"workdir={tmp_path}"] + list(overrides)
+    )
+    env = build_mesh(cfg.mesh)
+    return Trainer(cfg, mesh_env=env)
+
+
+def run_steps(trainer, n=3):
+    state = trainer.init_state()
+    for step in range(n):
+        state, metrics = trainer.train_step(
+            state, trainer.pipeline.global_batch(step)
+        )
+    return jax.device_get(state), jax.device_get(metrics)
+
+
+def assert_params_close(a, b, atol=2e-3):
+    """Default tolerance: well inside the ISSUE's 2e-2 acceptance band.
+    It can't be 1e-5-tight under adamw: parameters whose true gradient is
+    ~0 (e.g. attn/key/bias — softmax is key-bias invariant) get their
+    float-noise gradients amplified to lr-scale sign updates by m/sqrt(v),
+    and the explicit-collective path reorders those reductions. Losses and
+    grad norms stay bit-identical (asserted where compared)."""
+    jax.tree.map(
+        lambda x, y: np.testing.assert_allclose(x, y, atol=atol, rtol=1e-4),
+        a.params,
+        b.params,
+    )
+
+
+def gpt_pair(tmp_path, mesh, extra=()):
+    """(plain-FSDP state, overlap state) after 3 identical steps."""
+    ref = make_trainer(
+        "gpt2_medium_zero1", GPT_TINY, mesh + FSDP + list(extra),
+        tmp_path / "ref",
+    )
+    ovl = make_trainer(
+        "gpt2_medium_fsdp_overlap", GPT_TINY, mesh + list(extra),
+        tmp_path / "ovl",
+    )
+    return run_steps(ref), run_steps(ovl)
+
+
+def test_overlap_matches_fsdp_only_mesh(tmp_path):
+    """fsdp=8: the pure-FSDP mesh (batch sharded over fsdp too)."""
+    (ref, ref_m), (ovl, ovl_m) = gpt_pair(
+        tmp_path, ["mesh.data=1", "mesh.fsdp=8"]
+    )
+    assert_params_close(ref, ovl)
+    np.testing.assert_allclose(ovl_m["loss"], ref_m["loss"], atol=1e-5)
+    # The overlap config must actually shard the block params (a silently
+    # replicated run would also "match").
+    t = make_trainer(
+        "gpt2_medium_fsdp_overlap", GPT_TINY,
+        ["mesh.data=1", "mesh.fsdp=8"], tmp_path / "shard",
+    )
+    state = t.init_state()
+    qk = state.params["blocks"]["attn"]["query"]["kernel"]
+    assert any(
+        e == "fsdp" or (isinstance(e, tuple) and "fsdp" in e)
+        for e in qk.sharding.spec
+    ), qk.sharding.spec
+
+
+def test_overlap_matches_data_x_fsdp(tmp_path):
+    """data=2 x fsdp=4: the hybrid mesh of the acceptance gate."""
+    (ref, _), (ovl, _) = gpt_pair(tmp_path, ["mesh.data=2", "mesh.fsdp=4"])
+    assert_params_close(ref, ovl)
+
+
+def test_overlap_composes_with_tp(tmp_path):
+    """data=2 x fsdp=2 x model=2: gathers remove ONLY the fsdp axis; the
+    Megatron column/row splits stay sharded through the block compute."""
+    (ref, _), (ovl, _) = gpt_pair(
+        tmp_path, ["mesh.data=2", "mesh.fsdp=2", "mesh.model=2"]
+    )
+    assert_params_close(ref, ovl)
+
+
+def test_overlap_grad_accum_accumulates_sharded(tmp_path):
+    """grad_accum=4: microbatch grads accumulate as SHARDS. Numerics must
+    match, and the accumulated-grads constraint keeps the scan carry in
+    the params' sharded layout (asserted via the compiled step running on
+    the same shardings — a gathered fp32 carry would still be numerically
+    right, so the layout is pinned by grad_shardings in make_train_step)."""
+    (ref, _), (ovl, _) = gpt_pair(
+        tmp_path, ["mesh.data=1", "mesh.fsdp=8"],
+        extra=["trainer.grad_accum=4"],
+    )
+    assert_params_close(ref, ovl)
+
+
+@pytest.mark.parametrize("block_remat", ["full", "save_attn"])
+def test_overlap_block_remat_interaction(tmp_path, block_remat):
+    """Per-block remat modes compose: the gather rides inside the remat
+    region, so the backward re-gathers under every policy."""
+    (ref, _), (ovl, _) = gpt_pair(
+        tmp_path, ["mesh.data=1", "mesh.fsdp=8"],
+        extra=[f"model.block_remat={block_remat}"],
+    )
+    assert_params_close(ref, ovl)
+
+
+def test_overlap_remat_full_interaction(tmp_path):
+    """trainer.remat=full (whole-loss checkpoint) around the hooked model."""
+    (ref, _), (ovl, _) = gpt_pair(
+        tmp_path, ["mesh.data=1", "mesh.fsdp=8"],
+        extra=["trainer.remat=full"],
+    )
+    assert_params_close(ref, ovl)
+
+
+# --------------------------------------------------------------- blockwise
+
+
+def _walk_jaxpr(jaxpr, prim_name, found):
+    """Collect output shapes of every ``prim_name`` eqn, recursing into
+    sub-jaxprs (scan bodies, remat/custom_vjp calls, shard_map regions)."""
+    for eqn in jaxpr.eqns:
+        if prim_name in str(eqn.primitive):
+            found.append(tuple(v.aval.shape for v in eqn.outvars))
+        for v in eqn.params.values():
+            vs = v if isinstance(v, (list, tuple)) else [v]
+            for u in vs:
+                if hasattr(u, "eqns"):
+                    _walk_jaxpr(u, prim_name, found)
+                elif hasattr(u, "jaxpr") and hasattr(u.jaxpr, "eqns"):
+                    _walk_jaxpr(u.jaxpr, prim_name, found)
+    return found
+
+
+def test_overlap_gathers_are_blockwise(tmp_path):
+    """Peak gathered-param live set is ONE block, not the model: every
+    explicit all_gather in the step jaxpr produces a per-layer SLICE shape
+    (the stacked [L, ...] leaves never pass through a gather), and the
+    gathers sit inside the scan body, where XLA's collective pipeliner can
+    overlap iteration k+1's gather with iteration k's compute."""
+    t = make_trainer(
+        "gpt2_medium_fsdp_overlap", GPT_TINY,
+        ["mesh.data=2", "mesh.fsdp=4"], tmp_path,
+    )
+    state = t.init_state()
+    batch = t.pipeline.global_batch(0)
+    with mesh_context(t.env):
+        jaxpr = jax.make_jaxpr(t._train_step_fn)(state, batch)
+
+    gathers = _walk_jaxpr(jaxpr.jaxpr, "all_gather", [])
+    assert gathers, "overlap mode produced no explicit all_gather"
+
+    stacked = {
+        tuple(l.shape) for l in jax.tree.leaves(state.params["blocks"])
+    }
+    sliced = {s[1:] for s in stacked}
+    max_block_bytes = sum(
+        int(np.prod(s[1:])) * 4 for s in stacked
+    )
+    for out_shapes in gathers:
+        for shape in out_shapes:
+            assert shape not in stacked, (
+                f"full stacked leaf {shape} passed through an all_gather — "
+                "the gather is NOT blockwise"
+            )
+            assert shape in sliced, (
+                f"all_gather output {shape} is not a per-block param slice "
+                f"(expected one of {sorted(sliced)})"
+            )
+            assert int(np.prod(shape)) * 4 <= max_block_bytes
+
+    # The scan body must contain the gathers (that's what makes the
+    # schedule per-iteration): at least one scan eqn exists whose body
+    # carries all_gather eqns.
+    scans = []
+    for eqn in jaxpr.jaxpr.eqns:
+        if str(eqn.primitive) == "scan":
+            body_gathers = _walk_jaxpr(eqn.params["jaxpr"].jaxpr, "all_gather", [])
+            scans.append(len(body_gathers))
+    assert any(n > 0 for n in scans), (
+        "no scan body contains the explicit gathers — they were hoisted "
+        f"out of the layer loop (scan gather counts: {scans})"
+    )
+
+
+def test_overlap_backward_has_reduce_scatter(tmp_path):
+    """The gather's transpose is an explicit reduce-scatter (psum_scatter
+    binds the ``reduce_scatter`` primitive): gradients leave each block as
+    shards, never as full-model tensors."""
+    t = make_trainer(
+        "gpt2_medium_fsdp_overlap", GPT_TINY,
+        ["mesh.data=2", "mesh.fsdp=4"], tmp_path,
+    )
+    state = t.init_state()
+    batch = t.pipeline.global_batch(0)
+    with mesh_context(t.env):
+        jaxpr = jax.make_jaxpr(t._train_step_fn)(state, batch)
+    scatters = _walk_jaxpr(jaxpr.jaxpr, "reduce_scatter", [])
+    assert scatters, (
+        "no explicit reduce_scatter in the overlap step jaxpr — gradients "
+        "are not being scattered back into shards"
+    )
+
+
+# ----------------------------------------------------------------- resnet
+
+
+def test_resnet_overlap_matches(tmp_path):
+    """Per-block gather on the (non-scanned) ResNet stack, BatchNorm
+    mutation and all, matches the GSPMD FSDP path."""
+    ref = make_trainer(
+        "imagenet_rn50_ddp", RN_TINY,
+        ["mesh.data=2", "mesh.fsdp=4"] + FSDP, tmp_path / "ref",
+    )
+    ovl = make_trainer(
+        "imagenet_rn50_ddp", RN_TINY,
+        ["mesh.data=2", "mesh.fsdp=4"] + FSDP + ["parallel.fsdp_overlap=true"],
+        tmp_path / "ovl",
+    )
+    (ref_s, _), (ovl_s, _) = run_steps(ref), run_steps(ovl)
+    assert_params_close(ref_s, ovl_s)
+    # BatchNorm running stats advance identically too.
+    jax.tree.map(
+        lambda x, y: np.testing.assert_allclose(x, y, atol=1e-5, rtol=1e-5),
+        ref_s.extras,
+        ovl_s.extras,
+    )
+
+
+@pytest.mark.parametrize("prefetch", [0, 2])
+def test_resnet_prefetch_window_is_numerics_neutral(tmp_path, prefetch):
+    """fsdp_prefetch only reorders the schedule (optimization_barrier
+    gates); any window must produce identical math."""
+    ref = make_trainer(
+        "imagenet_rn50_ddp", RN_TINY,
+        ["mesh.data=2", "mesh.fsdp=4"] + FSDP, tmp_path / "ref",
+    )
+    ovl = make_trainer(
+        "imagenet_rn50_ddp", RN_TINY,
+        ["mesh.data=2", "mesh.fsdp=4"] + FSDP
+        + ["parallel.fsdp_overlap=true", f"parallel.fsdp_prefetch={prefetch}"],
+        tmp_path / "ovl",
+    )
+    (ref_s, _), (ovl_s, _) = run_steps(ref, n=2), run_steps(ovl, n=2)
+    assert_params_close(ref_s, ovl_s)
+
+
+# ------------------------------------------------------------- validation
+
+
+def test_overlap_requires_fsdp_sharding(tmp_path):
+    with pytest.raises(ValueError, match="param_sharding"):
+        make_trainer(
+            "gpt2_medium_zero1", GPT_TINY,
+            ["mesh.fsdp=8", "parallel.fsdp_overlap=true"], tmp_path,
+        )
+
+
+def test_overlap_refuses_pipeline(tmp_path):
+    with pytest.raises(ValueError, match="pipeline"):
+        make_trainer(
+            "gpt2_medium_fsdp_overlap", GPT_TINY,
+            ["mesh.data=1", "mesh.fsdp=4", "mesh.pipe=2",
+             "model.num_layers=4", "model.pipeline_stages=2"],
+            tmp_path,
+        )
+
+
+def test_overlap_refuses_negative_prefetch(tmp_path):
+    with pytest.raises(ValueError, match="fsdp_prefetch"):
+        make_trainer(
+            "gpt2_medium_fsdp_overlap", GPT_TINY,
+            ["mesh.data=1", "mesh.fsdp=8", "parallel.fsdp_prefetch=-1"],
+            tmp_path,
+        )
+
+
+def test_overlap_parity_dryrun_style(tmp_path):
+    """dryrun_multichip-style parity: first-step loss of the composed
+    data x fsdp overlap mesh agrees with the SAME config on one device
+    (tol 2e-2, the driver's parity band)."""
+    ovl = make_trainer(
+        "gpt2_medium_fsdp_overlap", GPT_TINY,
+        ["mesh.data=2", "mesh.fsdp=4"], tmp_path / "multi",
+    )
+    state = ovl.init_state()
+    _, m_multi = ovl.train_step(state, ovl.pipeline.global_batch(0))
+
+    cfg1 = apply_overrides(
+        get_config("gpt2_medium_zero1"),
+        GPT_TINY + [f"workdir={tmp_path}/single", "mesh.data=1", "mesh.fsdp=1"],
+    )
+    env1 = build_mesh(cfg1.mesh, devices=jax.devices()[:1])
+    single = Trainer(cfg1, mesh_env=env1)
+    s1 = single.init_state()
+    _, m_single = single.train_step(s1, single.pipeline.global_batch(0))
+    l_multi, l_single = float(m_multi["loss"]), float(m_single["loss"])
+    assert abs(l_multi - l_single) <= 2e-2 * max(1.0, abs(l_single)), (
+        l_multi, l_single,
+    )

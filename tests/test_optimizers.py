@@ -7,6 +7,8 @@ import pytest as _pytest_mark  # noqa: E402
 # Sub-2-minute smoke tier (COVERAGE.md "Test tiers"): this module's
 # measured wall time keeps `pytest -m fast` under the tier budget.
 pytestmark = _pytest_mark.mark.fast
+import os
+
 import jax
 import numpy as np
 
@@ -108,6 +110,50 @@ def test_adafactor_recipe_lr_actually_learns():
     # ~0.36 in 40 steps (measured 2026-07-30). 0.2 separates cleanly on
     # both sides.
     assert losses[-1] < losses[0] - 0.2, (losses[0], losses[-1])
+
+
+def test_adafactor_recipe_lr_at_10m_proxy():
+    """Round-6 de-risk of the recipe LR AT SCALE (ISSUE r6 satellite): the
+    committed ≥1k-step evidence run at the 10.34M-param proxy
+    (`tools/opt_convergence.py --scale 10m --steps 1000`,
+    evidence_r6/opt_convergence_10m.log) must back the pinned 1e-2 —
+    bracketed from below (3e-3 clearly under-trains: 2.68 vs 0.73) and
+    from above (3e-2 measured), with 1e-2 no worse than adamw's final
+    loss × the tool's 1.10 tolerance (measured: it WINS outright,
+    0.7274 vs 0.8519). The recipe must carry that LR and cite the log.
+    The 40-step early marker in the same rows shows why this pin reads
+    evidence instead of re-training: at 10M params the optimizers have
+    not separated by step 40 (all ≈9.03 from 9.06)."""
+    import json
+
+    log = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "evidence_r6", "opt_convergence_10m.log",
+    )
+    rows = [
+        json.loads(l) for l in open(log) if l.lstrip().startswith("{")
+    ]
+    by = {
+        (r["optimizer"], r["lr"]): r
+        for r in rows
+        if r.get("scale") == "10m" and "optimizer" in r
+    }
+    adamw = by[("adamw", 3e-4)]
+    lo, mid, hi = (by[("adafactor", lr)] for lr in (3e-3, 1e-2, 3e-2))
+    for r in (adamw, lo, mid, hi):
+        assert r["steps"] >= 1000, r  # the >=1k-step requirement
+    # The decision: 1e-2 converges at least as well as adamw at scale.
+    assert mid["loss_final_mean"] <= 1.10 * adamw["loss_final_mean"], (
+        mid, adamw,
+    )
+    # Bracketing from below is informative: a decade down under-trains.
+    assert lo["loss_final_mean"] > 1.5 * mid["loss_final_mean"], (lo, mid)
+    # And the registered recipe carries exactly the evidenced LR + cite.
+    recipe = get_config("gpt2_medium_adafactor")
+    assert recipe.optimizer.learning_rate == 1e-2
+    from frl_distributed_ml_scaffold_tpu.config import recipes
+
+    assert "opt_convergence_10m" in recipes.gpt2_medium_adafactor.__doc__
 
 
 def test_lion_composes_with_zero1_sharding():

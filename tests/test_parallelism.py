@@ -170,6 +170,19 @@ def test_opt_state_unmatched_leaf_warns_and_replicates():
     assert "factored_v_row" in warnings[0] and "tiny" not in warnings[0]
 
 
+def test_fsdp_overlap_refuses_unhooked_family(tmp_path):
+    """Overlap-scheduled FSDP (parallel/fsdp_overlap.py) exists only for
+    model families with blockwise apply hooks (gpt, resnet); an MLP config
+    must refuse loudly — a silent fallback to the GSPMD schedule would
+    invalidate any A/B built on the flag."""
+    with pytest.raises(ValueError, match="blockwise apply hooks"):
+        make_trainer(
+            tmp_path,
+            ["mesh.data=1", "mesh.fsdp=8"],
+            extra=["parallel.param_sharding=fsdp", "parallel.fsdp_overlap=true"],
+        )
+
+
 def test_grad_accum_matches(tmp_path, single_device_result):
     """Grad accumulation (SURVEY C12): 4 microbatches == 1 full batch."""
     trainer = make_trainer(

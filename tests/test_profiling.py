@@ -82,3 +82,62 @@ def test_trace_analyze_reports_cleanly_on_sim_trace(profiled_run):
     )
     assert r.returncode == 0, r.stderr[-400:]
     assert "no /device:TPU plane" in r.stdout
+
+
+def test_trace_overlap_interval_math():
+    """trace_analyze's comm/compute overlap sweep (the overlap-scheduled
+    FSDP evidence path): union-merge and intersection must be exact on
+    touching, nested and disjoint intervals."""
+    from tools.trace_analyze import COMM_OPS, _intersection_len, _merge
+
+    assert _merge([(5, 10), (0, 3), (2, 6), (20, 25)]) == [(0, 10), (20, 25)]
+    assert _intersection_len([(0, 10)], [(5, 15)]) == 5
+    assert _intersection_len([(0, 2), (8, 12)], [(1, 9)]) == 2
+    assert _intersection_len([(0, 2)], [(3, 4)]) == 0
+    # the classifier must recognize the collectives the overlap schedule
+    # emits (fusion names embed these substrings)
+    assert "all-gather" in COMM_OPS and "reduce-scatter" in COMM_OPS
+
+
+def test_trace_overlap_summary_output(capsys):
+    """overlap_summary on a synthetic lane: 4 ms of comm, 3 ms hidden
+    under compute, 1 ms exposed."""
+    from tools.trace_analyze import overlap_summary
+
+    class E:
+        def __init__(self, mid, off_ms, dur_ms):
+            self.metadata_id = mid
+            self.offset_ps = int(off_ms * 1e9)
+            self.duration_ps = int(dur_ms * 1e9)
+
+    class Line:
+        events = [
+            E(1, 0.0, 5.0),   # compute [0, 5)
+            E(2, 2.0, 4.0),   # all-gather [2, 6) -> 3 hidden, 1 exposed
+        ]
+
+    emeta = {1: "fusion.42", 2: "all-gather-start.3"}
+    overlap_summary(Line(), emeta)
+    out = capsys.readouterr().out
+    assert "comm 4.00 ms total" in out
+    assert "3.00 ms hidden" in out and "75.0%" in out
+    assert "1.00 ms exposed" in out
+
+
+def test_trace_overlap_summary_zero_duration_comm(capsys):
+    """Async collective pairs can log zero-duration start/done markers; a
+    lane with only those must report 'no duration', not ZeroDivisionError."""
+    from tools.trace_analyze import overlap_summary
+
+    class E:
+        def __init__(self, mid, off_ps, dur_ps):
+            self.metadata_id = mid
+            self.offset_ps = off_ps
+            self.duration_ps = dur_ps
+
+    class Line:
+        events = [E(1, 0, 5_000_000), E(2, 2_000_000, 0)]
+
+    overlap_summary(Line(), {1: "fusion.1", 2: "all-gather-start.7"})
+    out = capsys.readouterr().out
+    assert "no duration" in out

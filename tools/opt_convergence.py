@@ -43,18 +43,48 @@ def _force_cpu() -> None:
     jax.config.update("jax_platforms", "cpu")
 
 
-def run_one(opt_name: str, steps: int, lr: float) -> dict:
-    from frl_distributed_ml_scaffold_tpu.config import apply_overrides, get_config
-    from frl_distributed_ml_scaffold_tpu.trainer.loop import Trainer
-
-    # Tiny GPT on the synthetic-LM task: same model family and loss surface
-    # as the flagship, sized so 300 steps take seconds on the CPU sim. The
-    # synthetic stream has learnable structure (repeating n-gram statistics),
-    # so loss drops far below ln(vocab) and optimizers separate.
-    cfg = apply_overrides(get_config("gpt2_medium_zero1"), [
+#: Model-scale presets. "tiny" (~0.9M params) separates optimizers in
+#: seconds; "10m" (~10.4M params: d=384, L=4, T=256, V=8192) is the
+#: 10–30M-param proxy the adafactor recipe-LR decision is pinned at —
+#: big enough that the RELATIVE update's RMS(param) scaling and the
+#: factored second moment behave like the flagship's, small enough that
+#: >=1k steps complete on the CPU sim (ISSUE r6 satellite; evidence in
+#: evidence_r6/opt_convergence_10m.log).
+SCALES = {
+    "tiny": [
         "model.num_layers=2", "model.num_heads=4", "model.hidden_dim=128",
         "model.seq_len=128", "model.vocab_size=512",
         "data.seq_len=128", "data.vocab_size=512",
+    ],
+    "10m": [
+        "model.num_layers=4", "model.num_heads=6", "model.hidden_dim=384",
+        "model.seq_len=256", "model.vocab_size=8192",
+        "data.seq_len=256", "data.vocab_size=8192",
+    ],
+}
+
+
+def run_one(opt_name: str, steps: int, lr: float, scale: str = "tiny") -> dict:
+    import gc
+
+    import jax
+
+    from frl_distributed_ml_scaffold_tpu.config import apply_overrides, get_config
+    from frl_distributed_ml_scaffold_tpu.trainer.loop import Trainer
+
+    # Release the previous combo's params/opt-state/executables BEFORE this
+    # one allocates (same settle as tools/perf_sweep.py build()): at the
+    # 10m scale, three accumulated live Trainers are what silently killed
+    # the first 1k-step evidence run between configs 3 and 4.
+    gc.collect()
+    jax.clear_caches()
+    gc.collect()
+
+    # GPT on the synthetic-LM task: same model family and loss surface
+    # as the flagship, at a SCALES preset. The
+    # synthetic stream has learnable structure (repeating n-gram statistics),
+    # so loss drops far below ln(vocab) and optimizers separate.
+    cfg = apply_overrides(get_config("gpt2_medium_zero1"), SCALES[scale] + [
         "data.global_batch_size=8",
         "trainer.grad_accum=1", "trainer.remat=none",
         "trainer.log_every=1000000", "trainer.total_steps=%d" % steps,
@@ -77,7 +107,11 @@ def run_one(opt_name: str, steps: int, lr: float) -> dict:
         "optimizer": opt_name,
         "lr": lr,
         "steps": steps,
+        "scale": scale,
         "loss_first": round(losses[0], 4),
+        # Early-trajectory marker: what the regression pin in
+        # tests/test_optimizers.py can afford to re-measure.
+        "loss_step40": round(losses[min(39, steps - 1)], 4),
         "loss_final_mean": round(sum(tail) / len(tail), 4),
         "loss_min": round(min(losses), 4),
     }
@@ -86,6 +120,7 @@ def run_one(opt_name: str, steps: int, lr: float) -> dict:
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=300)
+    ap.add_argument("--scale", choices=sorted(SCALES), default="tiny")
     args = ap.parse_args()
     _force_cpu()
 
@@ -100,10 +135,14 @@ def main() -> int:
         "adafactor": [1e-2, 3e-2],
         "lion": [1e-4, 3e-4],
     }
+    if args.scale == "10m":
+        # The recipe-LR de-risk run: bracket the pinned 1e-2 from both
+        # sides; lion is out of scope for this decision.
+        grid = {"adamw": [3e-4], "adafactor": [3e-3, 1e-2, 3e-2]}
     rows = []
     for name, lrs in grid.items():
         for lr in lrs:
-            r = run_one(name, args.steps, lr)
+            r = run_one(name, args.steps, lr, scale=args.scale)
             rows.append(r)
             print(json.dumps(r), flush=True)
     best = {}

@@ -296,6 +296,49 @@ def gpt2_block_remat():
             )
 
 
+def gpt2_fsdp_overlap():
+    """Round-6 A/B, queued for the next multi-chip relay window (BACKLOG):
+    overlap-scheduled FSDP (parallel.fsdp_overlap — explicit per-block
+    all-gather/reduce-scatter with one-block-ahead prefetch) vs the plain
+    GSPMD FSDP schedule, at the flagship operating point of the
+    gpt2_medium_fsdp_overlap recipe. Needs >= 2 devices for a real fsdp
+    axis; on the single-chip relay it emits a skip row instead of a
+    meaningless comm-free "A/B". Correctness is already sim-gated
+    (tests/test_fsdp_overlap.py); this measures whether the explicit
+    schedule recovers the hidden gather time (docs/perf_playbook.md
+    "Overlap-scheduled FSDP")."""
+    import jax
+
+    n = jax.device_count()
+    if n < 2:
+        print(json.dumps({
+            "experiment": "gpt2_fsdp_overlap",
+            "skipped": f"needs >=2 devices for an fsdp axis (have {n})",
+        }), flush=True)
+        return
+    base = [
+        "model.attention=flash",
+        "model.lm_loss_chunk=128",
+        "trainer.grad_accum=1",
+        "trainer.remat=none",
+        "model.block_remat=full",
+        "mesh.data=1",
+        f"mesh.fsdp={n}",
+    ]
+    for overlap in ("false", "true"):
+        for per_chip in (8, 16):
+            bs = per_chip * n
+            measure_or_emit(
+                "gpt2_fsdp_overlap", bs, "gpt2_medium_fsdp_overlap",
+                base + [
+                    f"parallel.fsdp_overlap={overlap}",
+                    f"data.global_batch_size={bs}",
+                ],
+                {"fsdp_overlap": overlap, "n_chips": n},
+                n=10, warm=3,
+            )
+
+
 def moe_dispatch():
     """Round-5 A/B the FLOP table predicts sort wins (einsum exchange =
     66% of step FLOPs at the audited shapes; sort cuts total 1.79x —
@@ -377,7 +420,7 @@ GROUPS = {f.__name__: f for f in (rn50_bs, rn50_precision, rn50_fwd_only,
                                   rn50_headline, rn50_pool, gpt2_opt,
                                   gpt2_block_remat, gpt2_offload,
                                   rn50_fused_opt, rn50_fused_bn,
-                                  moe_dispatch)}
+                                  moe_dispatch, gpt2_fsdp_overlap)}
 
 if __name__ == "__main__":
     which = sys.argv[1:] or list(GROUPS)

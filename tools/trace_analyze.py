@@ -31,6 +31,75 @@ def find_xplane(root: str) -> str:
     return hits[-1]  # latest capture
 
 
+#: Op-name substrings that classify an XLA op as communication. The
+#: overlap summary keys on these (fusion names embed the collective name).
+COMM_OPS = ("all-gather", "all-reduce", "reduce-scatter",
+            "collective-permute", "all-to-all")
+
+
+def _merge(intervals):
+    """Sorted union of (start, end) intervals."""
+    out = []
+    for a, b in sorted(intervals):
+        if out and a <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], b))
+        else:
+            out.append((a, b))
+    return out
+
+
+def _intersection_len(xs, ys):
+    """Total overlap length between two MERGED interval lists."""
+    total, i, j = 0, 0, 0
+    while i < len(xs) and j < len(ys):
+        a = max(xs[i][0], ys[j][0])
+        b = min(xs[i][1], ys[j][1])
+        if a < b:
+            total += b - a
+        if xs[i][1] < ys[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
+def overlap_summary(line, emeta) -> None:
+    """Comm-vs-compute overlap evidence for one device timeline.
+
+    The number the overlap-scheduled FSDP A/B is after (perf_sweep
+    gpt2_fsdp_overlap / docs/perf_playbook.md): how much collective time
+    runs CONCURRENTLY with compute vs exposed on the critical path.
+    Computed as an interval sweep over the XLA Ops lane: union the comm
+    events' wall intervals, union the compute events', intersect.
+    """
+    comm, comp = [], []
+    for e in line.events:
+        name = emeta[e.metadata_id]
+        iv = (e.offset_ps, e.offset_ps + e.duration_ps)
+        if any(k in name for k in COMM_OPS):
+            comm.append(iv)
+        else:
+            comp.append(iv)
+    if not comm:
+        print("  overlap: no collective ops in this lane")
+        return
+    comm_m, comp_m = _merge(comm), _merge(comp)
+    comm_ms = sum(b - a for a, b in comm_m) / 1e9
+    if comm_ms <= 0.0:
+        # Async collective pairs can log zero-duration start/done marker
+        # events; a lane with only those has no measurable comm window.
+        print("  overlap: collective events carry no duration in this lane")
+        return
+    hidden_ms = _intersection_len(comm_m, comp_m) / 1e9
+    exposed_ms = comm_ms - hidden_ms
+    print(
+        f"  overlap: comm {comm_ms:.2f} ms total, "
+        f"{hidden_ms:.2f} ms hidden under compute "
+        f"({100.0 * hidden_ms / comm_ms:.1f}%), "
+        f"{exposed_ms:.2f} ms exposed"
+    )
+
+
 def main() -> int:
     from tensorflow.tsl.profiler.protobuf import xplane_pb2
 
@@ -78,6 +147,7 @@ def main() -> int:
                 print(
                     f"  {ps / 1e9 / n_steps:8.2f} {n_events[name]:6d}  {name[:120]}"
                 )
+            overlap_summary(line, emeta)
     return 0
 
 
