@@ -56,7 +56,14 @@ class Checkpointer:
         restore). Explicit per-leaf restore args carry the CALLER's
         shardings, so this reshards across topologies like ``restore``
         (PyTreeRestore would otherwise read the writer's sharding file,
-        which is invalid on a different device set). Returns params."""
+        which is invalid on a different device set). Returns params.
+
+        Compat: ``ocp.PLACEHOLDER`` only exists on newer orbax releases;
+        older ones (e.g. the 0.7.x in this container) fall back to a full
+        restore and take the params subtree — identical result, just
+        without the skipped-moments I/O saving."""
+        if not hasattr(ocp, "PLACEHOLDER"):
+            return self.restore(state_shapes, state_shardings, step).params
         abstract = jax.tree.map(
             lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
             state_shapes,

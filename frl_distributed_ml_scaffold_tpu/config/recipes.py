@@ -202,6 +202,31 @@ def gpt2_medium_fsdp_overlap() -> ExperimentConfig:
     )
 
 
+@register_config("gpt2_medium_tp_overlap")
+def gpt2_medium_tp_overlap() -> ExperimentConfig:
+    """Flagship LM under latency-hiding tensor parallelism
+    (parallel/tp_overlap.py): the four per-block TP matmuls run as
+    bidirectional collective-matmul rings (ppermute-chained blocks, comm
+    hidden under compute) with the residual stream sequence-sharded over
+    the model axis, instead of GSPMD's monolithic per-layer allreduces.
+    The sweep config for the on-chip A/B (tools/perf_sweep.py
+    gpt2_tp_overlap, queued in BACKLOG R7): same operating point as the
+    gpt2_tp showcase so the step-time delta reads as the scheduling win
+    alone. Correctness is sim-gated in tests/test_tp_overlap.py (numerics
+    vs the GSPMD TP path, blockwise-ppermute jaxpr pins, mesh
+    compositions)."""
+    base = gpt2_medium_zero1()
+    return base.replace(
+        name="gpt2_medium_tp_overlap",
+        mesh=MeshConfig(data=1, model=-1),
+        parallel=ParallelConfig(
+            param_sharding="replicated",
+            opt_sharding="zero1",
+            tp_overlap=True,
+        ),
+    )
+
+
 # ----- task-required parallelism showcases beyond the reference configs -----
 
 

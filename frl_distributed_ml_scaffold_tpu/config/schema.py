@@ -85,6 +85,17 @@ class ParallelConfig:
       with optimization barriers; on the scanned transformer stack the
       rolled loop exposes exactly one block of lookahead to XLA's
       collective pipeliner, so values > 1 behave as 1 there.
+    - ``tp_overlap``: opt-in latency-hiding tensor parallelism
+      (parallel/tp_overlap.py, the collective-matmul schedule of the JAX
+      pjit/TPUv4 scaling paper): the four per-block TP matmuls (QKV,
+      attn-out, fc_in, fc_out — and the ViT/video equivalents) become
+      bidirectional ``ppermute`` rings that hide the model-axis
+      communication under their own block compute, with the residual
+      stream sharded over the model axis between them, instead of GSPMD's
+      monolithic per-layer allreduces. Requires ``mesh.model > 1`` and a
+      model family with hooks (gpt, vit, video); composes with data/fsdp
+      meshes and ``fsdp_overlap``, not with pipeline/sequence parallelism
+      or MoE.
     """
 
     param_sharding: str = "replicated"  # replicated | fsdp
@@ -93,6 +104,7 @@ class ParallelConfig:
     fsdp_min_size: int = 1024
     fsdp_overlap: bool = False
     fsdp_prefetch: int = 1
+    tp_overlap: bool = False
 
 
 @dataclass(frozen=True)

@@ -74,6 +74,11 @@ class DataPipeline:
         return self.source.batch(step, self.local_batch_size, host_offset=self._proc)
 
     def global_batch(self, step: int) -> dict[str, jax.Array]:
+        """Host batch -> device-committed sharded arrays. ``shardings_for``
+        is the single source of truth for placement, so the H2D transfer
+        lands each slice directly on its home devices — whichever thread
+        runs this (the prefetch worker, in the training loop) pays the
+        transfer, not the consumer."""
         local = self.local_batch(step)
         shardings = self.shardings_for(local)
         return {
@@ -126,6 +131,13 @@ class PrefetchingPipeline:
     One worker is enough: batch assembly need only be faster than the
     compiled step, not parallel with itself, and a single worker keeps
     device-transfer ordering deterministic.
+
+    The worker does NOT stop at host arrays: it runs the full
+    ``DataPipeline.global_batch`` (``shardings_for`` + device placement)
+    AND waits for the transfers to land, so a consumed prefetched batch is
+    already committed and resident on its devices — the consumer thread's
+    only work is dispatching the step, never H2D (tested by
+    tests/test_native_data.py::test_prefetch_transfers_on_worker_thread).
     """
 
     def __init__(self, pipeline: DataPipeline, depth: int = 2):
@@ -162,8 +174,20 @@ class PrefetchingPipeline:
         fut = self._futures.pop(step, None)
         for s in range(step + 1, step + 1 + self._depth):
             if s not in self._futures:
-                self._futures[s] = self._ex.submit(self._p.global_batch, s)
-        return fut.result() if fut is not None else self._p.global_batch(step)
+                self._futures[s] = self._ex.submit(self._build, s)
+        # Cache miss (first call, resume jump): build through the same
+        # _build path so the committed-and-resident contract holds for
+        # every consumed batch, not just prefetched ones.
+        return fut.result() if fut is not None else self._build(step)
+
+    def _build(self, step: int) -> dict[str, jax.Array]:
+        """Worker-side batch build INCLUDING the H2D wait: device_put is
+        async in jax, so without the block the consumer could still inherit
+        an in-flight transfer; blocking here pins the whole transfer under
+        the previous device step instead."""
+        batch = self._p.global_batch(step)
+        jax.block_until_ready(list(batch.values()))
+        return batch
 
     def close(self) -> None:
         """Cancel in-flight work and release the worker thread. Trainer.fit

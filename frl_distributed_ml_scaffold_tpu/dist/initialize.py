@@ -46,6 +46,7 @@ def initialize_distributed(
         # a stale FRL_TPU_COORDINATOR is still in the environment.
         return
     if num_processes is not None and num_processes > 1:
+        _enable_cpu_collectives()
         # Bounded rendezvous: when a peer host is gone for good, the default
         # 300 s initialization timeout is what the elastic supervisor's
         # shrink policy (launcher/elastic.py) waits on — let deployments
@@ -63,6 +64,25 @@ def initialize_distributed(
         jax.distributed.initialize(coordinator_address=coordinator_address)
         _INITIALIZED = True
     # else: single process — nothing to initialize.
+
+
+def _enable_cpu_collectives() -> None:
+    """Multi-process compiled collectives on the CPU backend need an
+    explicit cross-process implementation (jax's default is 'none', which
+    raises "Multiprocess computations aren't implemented on the CPU
+    backend" at the first psum). Select gloo BEFORE the backend
+    initializes — this is what makes the 2-process CPU-sim tests
+    (test_multiprocess / test_elastic_multiprocess) real collectives
+    rather than a capability of some boxes and not others. Set
+    unconditionally for multi-process topologies: it only configures the
+    CPU backend's cross-process transport, so on TPU pods it is inert
+    (platform sniffing here is a trap — probing the backend would
+    initialize it prematurely, and the config flags differ across jax
+    releases). No-op on jax builds without the knob."""
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # older/newer jax without the option: leave default
+        pass
 
 
 def process_count() -> int:

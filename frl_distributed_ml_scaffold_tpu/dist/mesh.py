@@ -86,6 +86,31 @@ def resolve_axis_sizes(cfg: MeshConfig, n_devices: int) -> dict[str, int]:
     return sizes
 
 
+def enable_sharding_invariant_rng() -> None:
+    """Make jax.random streams independent of sharding/mesh layout.
+
+    jax's legacy (non-partitionable) threefry lowers RNG in a way that can
+    produce DIFFERENT values for the same key depending on how the output
+    is sharded — measured in this container: ``jit(init,
+    out_shardings=...)`` of the same seed gives different kernels on a
+    data=2 x fsdp=4 mesh than on one device (while fsdp=8 happens to
+    match), which silently breaks every cross-mesh equivalence guarantee
+    this repo makes (tests AND real reshard-resume workflows).
+    ``jax_threefry_partitionable=True`` is the upstream fix: counter-based
+    bit generation, identical values under any sharding, and faster under
+    SPMD. Called from ``build_mesh`` so every entry point (trainer, bench,
+    tools, tests) agrees; escape hatch for bit-exact continuity of runs
+    seeded under the legacy impl: FRL_TPU_LEGACY_RNG=1."""
+    import os
+
+    if os.environ.get("FRL_TPU_LEGACY_RNG"):
+        return
+    try:
+        jax.config.update("jax_threefry_partitionable", True)
+    except Exception:  # a jax without the flag already behaves this way
+        pass
+
+
 def build_mesh(cfg: MeshConfig, devices=None) -> MeshEnv:
     """Construct the mesh with topology-aware device ordering.
 
@@ -93,6 +118,7 @@ def build_mesh(cfg: MeshConfig, devices=None) -> MeshEnv:
     devices are ICI-adjacent; ``create_hybrid_device_mesh`` additionally
     keeps DCN-crossing axes outermost for multi-slice (``dcn_data > 1``).
     """
+    enable_sharding_invariant_rng()
     devices = list(jax.devices()) if devices is None else list(devices)
     sizes = resolve_axis_sizes(cfg, len(devices))
     shape = tuple(sizes[a] for a in AXES)

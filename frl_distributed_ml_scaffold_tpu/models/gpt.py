@@ -130,6 +130,13 @@ def _masked_dense_attention(q, k, v, mask):
 class CausalSelfAttention(nn.Module):
     config: GPTConfig
     dtype: Any
+    # Collective-matmul TP schedule (parallel/tp_overlap.py TpHooks): when
+    # set, QKV share one bidirectional all-gather-matmul ring (the first
+    # projection streams the sequence shards in under its own compute and
+    # hands the assembled copy to its siblings) and the out projection is
+    # a matmul-reduce-scatter ring instead of matmul+allreduce. Params are
+    # untouched — the hooks ride nn.Dense's injectable dot_general.
+    tp: Any = None
 
     @nn.compact
     def __call__(
@@ -139,9 +146,20 @@ class CausalSelfAttention(nn.Module):
         d = cfg.hidden_dim
         h = cfg.num_heads
         hd = d // h
-        q = nn.Dense(d, dtype=self.dtype, name="query")(x)
-        k = nn.Dense(d, dtype=self.dtype, name="key")(x)
-        v = nn.Dense(d, dtype=self.dtype, name="value")(x)
+        tp = None if decode else self.tp
+        qkv_dg = tp.qkv_context().dot_general if tp is not None else None
+        out_dg = tp.mrs_dot_general if tp is not None else None
+        if tp is not None:
+            # Pre-cast to the compute dtype so flax's per-Dense
+            # promote_dtype is an identity: the shared-QKV ring cache keys
+            # on input-object identity, and under bf16_mixed the fp32
+            # LayerNorm output would otherwise become THREE distinct cast
+            # tracers — three gather rings instead of one. Numerically a
+            # no-op (Dense performs this exact cast internally).
+            x = x.astype(self.dtype)
+        q = nn.Dense(d, dtype=self.dtype, name="query", dot_general=qkv_dg)(x)
+        k = nn.Dense(d, dtype=self.dtype, name="key", dot_general=qkv_dg)(x)
+        v = nn.Dense(d, dtype=self.dtype, name="value", dot_general=qkv_dg)(x)
         b, t, _ = x.shape
         q = q.reshape(b, t, h, hd)
         k = k.reshape(b, t, h, hd)
@@ -203,7 +221,7 @@ class CausalSelfAttention(nn.Module):
             y = dense_attention(q, k, v, causal=True)
 
         y = y.reshape(b, t, d)
-        y = nn.Dense(d, dtype=self.dtype, name="out")(y)
+        y = nn.Dense(d, dtype=self.dtype, name="out", dot_general=out_dg)(y)
         y = nn.Dropout(cfg.dropout, deterministic=not train)(y)
         return y
 
@@ -211,13 +229,24 @@ class CausalSelfAttention(nn.Module):
 class GptMlp(nn.Module):
     config: GPTConfig
     dtype: Any
+    tp: Any = None  # collective-matmul hooks (see CausalSelfAttention)
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, train: bool) -> jnp.ndarray:
         cfg = self.config
-        y = nn.Dense(cfg.hidden_dim * cfg.mlp_ratio, dtype=self.dtype, name="fc_in")(x)
+        tp = self.tp
+        ag_dg = tp.ag_dot_general if tp is not None else None
+        mrs_dg = tp.mrs_dot_general if tp is not None else None
+        y = nn.Dense(
+            cfg.hidden_dim * cfg.mlp_ratio,
+            dtype=self.dtype,
+            name="fc_in",
+            dot_general=ag_dg,
+        )(x)
         y = nn.gelu(y)
-        y = nn.Dense(cfg.hidden_dim, dtype=self.dtype, name="fc_out")(y)
+        y = nn.Dense(
+            cfg.hidden_dim, dtype=self.dtype, name="fc_out", dot_general=mrs_dg
+        )(y)
         y = nn.Dropout(cfg.dropout, deterministic=not train)(y)
         return y
 
@@ -227,13 +256,14 @@ class Block(nn.Module):
     dtype: Any
     train: bool  # static per-trace; bound at GPT.__call__ construction time
     decode: bool = False  # KV-cache incremental decoding
+    tp: Any = None  # collective-matmul TP hooks (parallel/tp_overlap.py)
 
     @nn.compact
     def __call__(self, carry, _unused):
         x, aux_loss = carry
-        cfg, train = self.config, self.train
+        cfg, train, tp = self.config, self.train, self.tp
         y = nn.LayerNorm(dtype=jnp.float32, epsilon=cfg.layer_norm_epsilon, name="ln1")(x)
-        attn_out = CausalSelfAttention(cfg, self.dtype, name="attn")(
+        attn_out = CausalSelfAttention(cfg, self.dtype, tp=tp, name="attn")(
             y, train=train, decode=self.decode
         )
         # Named for block_remat="save_attn": saving this one [B,T,D] tensor
@@ -241,6 +271,13 @@ class Block(nn.Module):
         # (the quadratic part). A no-op unless a checkpoint policy asks.
         attn_out = checkpoint_name(attn_out, "attn_out")
         x = x + attn_out
+        if tp is not None:
+            # Keep the residual stream sequence-sharded over the model axis
+            # between the reduce-scatter that produced attn_out and the
+            # gather ring that will consume ln2's output: the add and the
+            # LayerNorms are per-token, so anchoring here keeps the whole
+            # inter-matmul segment local.
+            x = tp.constrain_stream(x)
         y = nn.LayerNorm(dtype=jnp.float32, epsilon=cfg.layer_norm_epsilon, name="ln2")(x)
         if cfg.moe.num_experts > 0:
             from frl_distributed_ml_scaffold_tpu.models.moe import MoEMlp
@@ -248,8 +285,10 @@ class Block(nn.Module):
             mlp_out, layer_aux = MoEMlp(cfg, self.dtype, name="moe")(y, train=train)
             aux_loss = aux_loss + layer_aux
         else:
-            mlp_out = GptMlp(cfg, self.dtype, name="mlp")(y, train=train)
+            mlp_out = GptMlp(cfg, self.dtype, tp=tp, name="mlp")(y, train=train)
         x = x + mlp_out
+        if tp is not None:
+            x = tp.constrain_stream(x)
         return (x, aux_loss), None
 
 
@@ -265,6 +304,12 @@ class GPT(nn.Module):
     # specs exist; init/decode always run unhooked — the params tree is
     # identical either way.
     param_hooks: Any = None
+    # Collective-matmul TP schedule (parallel/tp_overlap.py TpHooks):
+    # replaces the four GSPMD TP matmuls per block (QKV, attn-out, fc_in,
+    # fc_out) with latency-hiding ppermute rings and keeps the residual
+    # stream sequence-sharded over the model axis. Attached by the Trainer
+    # like param_hooks; init/decode always run unhooked.
+    tp_overlap: Any = None
 
     @nn.compact
     def __call__(
@@ -387,7 +432,14 @@ class GPT(nn.Module):
                 length=cfg.num_layers,
                 variable_axes={"params": 0, "cache": 0},
                 split_rngs={"params": True, "dropout": True},
-            )(cfg, dtype, train, decode, name="blocks")
+            )(
+                cfg,
+                dtype,
+                train,
+                decode,
+                None if decode else self.tp_overlap,
+                name="blocks",
+            )
             (x, aux_loss), _ = blocks((x, jnp.zeros((), jnp.float32)), None)
 
         x = nn.LayerNorm(dtype=jnp.float32, epsilon=cfg.layer_norm_epsilon, name="ln_f")(x)

@@ -8,6 +8,8 @@ token sequence with mean pooling. Reuses the ViT encoder blocks.
 
 from __future__ import annotations
 
+from typing import Any
+
 import flax.linen as nn
 import jax.numpy as jnp
 
@@ -19,6 +21,9 @@ from frl_distributed_ml_scaffold_tpu.precision import Policy
 class VideoClassifier(nn.Module):
     config: VideoConfig
     policy: Policy
+    # Collective-matmul TP hooks (parallel/tp_overlap.py), attached by the
+    # Trainer for the loss path only (see vit.EncoderBlock).
+    tp_overlap: Any = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
@@ -50,6 +55,7 @@ class VideoClassifier(nn.Module):
                 mlp_ratio=cfg.mlp_ratio,
                 dropout=cfg.dropout,
                 dtype=dtype,
+                tp=self.tp_overlap,
             )(x, train=train)
 
         x = nn.LayerNorm(dtype=jnp.float32)(x)

@@ -54,13 +54,18 @@ class MlpBlock(nn.Module):
     mlp_ratio: int
     dropout: float
     dtype: Any = jnp.float32
+    tp: Any = None  # collective-matmul TP hooks (parallel/tp_overlap.py)
 
     @nn.compact
     def __call__(self, x, *, train: bool):
-        y = nn.Dense(self.dim * self.mlp_ratio, dtype=self.dtype)(x)
+        ag_dg = self.tp.ag_dot_general if self.tp is not None else None
+        mrs_dg = self.tp.mrs_dot_general if self.tp is not None else None
+        y = nn.Dense(
+            self.dim * self.mlp_ratio, dtype=self.dtype, dot_general=ag_dg
+        )(x)
         y = nn.gelu(y)
         y = nn.Dropout(self.dropout, deterministic=not train)(y)
-        y = nn.Dense(self.dim, dtype=self.dtype)(y)
+        y = nn.Dense(self.dim, dtype=self.dtype, dot_general=mrs_dg)(y)
         y = nn.Dropout(self.dropout, deterministic=not train)(y)
         return y
 
@@ -70,28 +75,60 @@ class EncoderBlock(nn.Module):
     mlp_ratio: int
     dropout: float
     dtype: Any = jnp.float32
+    # Collective-matmul TP schedule (parallel/tp_overlap.py): the q/k/v
+    # projections share one batch-chunked all-gather-matmul ring (injected
+    # via flax's qkv_dot_general — param layout untouched) and the out /
+    # MLP down projections become matmul-reduce-scatter rings, so the
+    # residual stream between sublayers stays batch-sharded over the model
+    # axis and no monolithic activation collective is exposed.
+    tp: Any = None
 
     @nn.compact
     def __call__(self, x, *, train: bool):
         dim = x.shape[-1]
+        tp = self.tp
+        qkv_dg = tp.qkv_context().dot_general if tp is not None else None
+        out_dg = tp.mrs_dot_general if tp is not None else None
         y = nn.LayerNorm(dtype=jnp.float32)(x)
+        if tp is not None:
+            # Pre-cast so the MHA's three per-projection promote_dtype
+            # calls are identities and the shared-QKV ring cache (keyed on
+            # input-object identity) hits under bf16_mixed — one gather
+            # ring, not three. Numerically a no-op (DenseGeneral performs
+            # this exact cast internally).
+            y = y.astype(self.dtype)
         y = nn.MultiHeadDotProductAttention(
             num_heads=self.num_heads,
             dtype=self.dtype,
             dropout_rate=self.dropout,
             deterministic=not train,
+            qkv_dot_general=qkv_dg,
+            out_dot_general=out_dg,
         )(y, y)
         x = x + y
+        if tp is not None:
+            x = tp.constrain_stream(x)
         y = nn.LayerNorm(dtype=jnp.float32)(x)
         y = MlpBlock(
-            dim=dim, mlp_ratio=self.mlp_ratio, dropout=self.dropout, dtype=self.dtype
+            dim=dim,
+            mlp_ratio=self.mlp_ratio,
+            dropout=self.dropout,
+            dtype=self.dtype,
+            tp=tp,
         )(y, train=train)
-        return x + y
+        x = x + y
+        if tp is not None:
+            x = tp.constrain_stream(x)
+        return x
 
 
 class ViT(nn.Module):
     config: ViTConfig
     policy: Policy
+    # Collective-matmul TP hooks (parallel/tp_overlap.py), attached by the
+    # Trainer for the loss path only — init always runs unhooked and the
+    # params tree is identical either way (see EncoderBlock).
+    tp_overlap: Any = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
@@ -126,6 +163,7 @@ class ViT(nn.Module):
                 mlp_ratio=cfg.mlp_ratio,
                 dropout=cfg.dropout,
                 dtype=dtype,
+                tp=self.tp_overlap,
             )(x, train=train)
 
         x = nn.LayerNorm(dtype=jnp.float32)(x)

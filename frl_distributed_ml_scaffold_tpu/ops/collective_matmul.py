@@ -1,0 +1,326 @@
+"""Latency-hiding collective matmul pair (SURVEY C6; the TP analogue of
+parallel/fsdp_overlap.py's explicit FSDP schedule).
+
+Under plain GSPMD tensor parallelism the per-layer ``model``-axis
+collectives (the Megatron f/g pair around QKV/out and fc_in/fc_out) are
+monolithic ops serialized against the matmuls they feed — fully exposed on
+every layer's critical path. "Scalable Training of Language Models using
+JAX pjit and TPUv4" (PAPERS.md) decomposes each matmul+collective into
+per-shard blocks chained by ``ppermute`` so each block's communication
+rides under the previous block's compute; "Memory-efficient array
+redistribution through portable collective communication" gives the same
+blockwise-ring framing for the transpose path. This module is that pair,
+written per-shard (callers wrap it in ``shard_map`` — see
+parallel/tp_overlap.py):
+
+- ``all_gather_matmul``: ``x`` sharded along a chunk dim (sequence for the
+  GPT stack, batch for ViT) times a column-split ``w``. A *bidirectional*
+  ring — each step multiplies the resident chunk while the next chunks
+  stream in from both neighbors, using both directions of the ICI links —
+  produces the gathered-times-split result without ever materializing the
+  gathered activation as the output of one monolithic collective.
+- ``matmul_reduce_scatter``: its transpose. Partial products accumulate
+  into chunk accumulators that rotate around the ring (again both
+  directions, split along the output features), so each hop's partial-sum
+  transfer hides under the next chunk's matmul; the full partial-product
+  tensor (the allreduce input GSPMD would build) never exists.
+
+Each op carries a ``jax.custom_vjp`` making the backward of one the
+forward schedule of the other (the gather's transpose IS the
+reduce-scatter), with the weight gradient accumulated blockwise inside the
+same ring — so no full-size gathered activation is saved or rebuilt
+monolithically in either direction.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from frl_distributed_ml_scaffold_tpu.dist import collectives
+
+
+def _ring_perms(n: int):
+    """(forward, backward) neighbor permutations: src -> src+1 / src-1."""
+    fwd = [(i, (i + 1) % n) for i in range(n)]
+    bwd = [(i, (i - 1) % n) for i in range(n)]
+    return fwd, bwd
+
+
+def _take(a, start, length, axis):
+    return lax.dynamic_slice_in_dim(a, start, length, axis=axis)
+
+
+def _put(a, update, start, axis):
+    return lax.dynamic_update_slice_in_dim(a, update, start, axis=axis)
+
+
+def _mm(x, w, precision):
+    """Contract x's last dim with w's first: [..., K] x [K, M] -> [..., M]."""
+    return lax.dot_general(
+        x, w, (((x.ndim - 1,), (0,)), ((), ())), precision=precision
+    )
+
+
+def _wgrad(chunk, stat, order, precision):
+    """Blockwise weight-grad contribution: contract every non-feature dim.
+
+    ``order="lhs"`` -> chunk^T @ stat (the all-gather-matmul's dw, [K, M]);
+    ``order="rhs"`` -> stat^T @ chunk (the reduce-scatter's dw, [M, K]).
+    Accumulated in fp32: the monolithic dot this replaces reduces on the
+    MXU in fp32; a bf16 chain of n partial adds would not.
+    """
+    a, b = (chunk, stat) if order == "lhs" else (stat, chunk)
+    nb = a.ndim - 1
+    return lax.dot_general(
+        a,
+        b,
+        (((tuple(range(nb)),) * 2), ((), ())),
+        precision=precision,
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _stream_ring(
+    x,
+    axis_name: str,
+    chunk_axis: int,
+    *,
+    w=None,
+    stationary=None,
+    wgrad_order: str = "lhs",
+    return_full: bool = False,
+    precision=None,
+):
+    """Bidirectional ppermute ring over ``x``'s shards.
+
+    Every shard's chunk visits every device (split in half along
+    ``chunk_axis``, one half streaming each direction so both link
+    directions carry traffic). Per visiting chunk ``c`` (the shard
+    originally resident on device ``c``), optionally:
+
+    - ``w``:          y[rows c] = chunk @ w        (all-gather-matmul)
+    - ``return_full``: full[rows c] = chunk        (assembled gather)
+    - ``stationary``:  dw += wgrad(chunk, stationary[rows c])
+
+    Returns ``(y, full, dw)`` with unused slots ``None``.
+    """
+    n = collectives.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    tc = x.shape[chunk_axis]
+    gathered = list(x.shape)
+    gathered[chunk_axis] = n * tc
+
+    y = full = dw = None
+    if w is not None:
+        y_shape = gathered[:-1] + [w.shape[-1]]
+        y = jnp.zeros(y_shape, jnp.result_type(x.dtype, w.dtype))
+    if return_full:
+        full = jnp.zeros(gathered, x.dtype)
+    if stationary is not None:
+        k, m = x.shape[-1], stationary.shape[-1]
+        shape = (k, m) if wgrad_order == "lhs" else (m, k)
+        dw = jnp.zeros(shape, jnp.float32)
+
+    fwd, bwd = _ring_perms(n)
+    half = tc // 2
+    bidir = n > 1 and tc % 2 == 0 and tc >= 2
+
+    def visit(y, full, dw, chunk, c, off):
+        start = c * tc + off
+        if w is not None:
+            y = _put(y, _mm(chunk, w, precision), start, chunk_axis)
+        if return_full:
+            full = _put(full, chunk, start, chunk_axis)
+        if stationary is not None:
+            stat_c = _take(
+                stationary, start, chunk.shape[chunk_axis], chunk_axis
+            )
+            dw = dw + _wgrad(chunk, stat_c, wgrad_order, precision)
+        return y, full, dw
+
+    if bidir:
+        lo = _take(x, 0, half, chunk_axis)
+        hi = _take(x, half, tc - half, chunk_axis)
+        c_lo = idx
+        c_hi = idx
+        for step in range(n):
+            y, full, dw = visit(y, full, dw, lo, c_lo, 0)
+            y, full, dw = visit(y, full, dw, hi, c_hi, half)
+            if step < n - 1:
+                # lo rides src->src+1 (each device receives from its left
+                # neighbor), hi rides the opposite direction: after s hops
+                # this device holds chunks idx-s and idx+s.
+                lo = lax.ppermute(lo, axis_name, fwd)
+                hi = lax.ppermute(hi, axis_name, bwd)
+                c_lo = (c_lo - 1) % n
+                c_hi = (c_hi + 1) % n
+    else:
+        chunk = x
+        c = idx
+        for step in range(n):
+            y, full, dw = visit(y, full, dw, chunk, c, 0)
+            if step < n - 1:
+                chunk = lax.ppermute(chunk, axis_name, fwd)
+                c = (c - 1) % n
+    if dw is not None:
+        target = jnp.result_type(
+            x.dtype, stationary.dtype if stationary is not None else x.dtype
+        )
+        dw = dw.astype(target)
+    return y, full, dw
+
+
+def _rotating_ring(
+    y, w, axis_name: str, chunk_axis: int, *, extra=None, precision=None
+):
+    """Rotating-accumulator ring: ``z`` chunk ``c`` = sum over devices j of
+    ``y_j[rows c] @ w_j`` (+ ``extra_j[rows c]``), ending on device ``c``.
+
+    Bidirectional: the accumulator is split in half along the OUTPUT
+    feature dim, one half circulating each direction, so each hop moves
+    half-size messages on both links while the next chunk's matmul runs.
+    """
+    n = collectives.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    tc = y.shape[chunk_axis] // n
+    d = w.shape[-1]
+    fwd, bwd = _ring_perms(n)
+    out_dtype = jnp.result_type(y.dtype, w.dtype)
+
+    def contrib(c, col0, cols):
+        y_c = _take(y, c * tc, tc, chunk_axis)
+        part = _mm(y_c, w[:, col0 : col0 + cols], precision)
+        if extra is not None:
+            part = part + lax.slice_in_dim(
+                _take(extra, c * tc, tc, chunk_axis), col0, col0 + cols, axis=-1
+            ).astype(part.dtype)
+        return part
+
+    bidir = n > 1 and d % 2 == 0 and d >= 2
+    if bidir:
+        dh = d // 2
+        acc_lo = acc_hi = None
+        for step in range(n):
+            c_lo = (idx - 1 - step) % n
+            c_hi = (idx + 1 + step) % n
+            p_lo = contrib(c_lo, 0, dh)
+            p_hi = contrib(c_hi, dh, d - dh)
+            acc_lo = p_lo if acc_lo is None else acc_lo + p_lo
+            acc_hi = p_hi if acc_hi is None else acc_hi + p_hi
+            if step < n - 1:
+                # acc for chunk c walks c+1, c+2, ..., ending home at c
+                # (and mirrored for the other half).
+                acc_lo = lax.ppermute(acc_lo, axis_name, fwd)
+                acc_hi = lax.ppermute(acc_hi, axis_name, bwd)
+        z = jnp.concatenate([acc_lo, acc_hi], axis=-1)
+    else:
+        acc = None
+        for step in range(n):
+            c = (idx - 1 - step) % n
+            p = contrib(c, 0, d)
+            acc = p if acc is None else acc + p
+            if step < n - 1:
+                acc = lax.ppermute(acc, axis_name, fwd)
+        z = acc
+    return z.astype(out_dtype)
+
+
+# ------------------------------------------------------------------ public
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def all_gather_matmul(x, w, axis_name, chunk_axis, return_full=False,
+                      precision=None):
+    """Per-shard blockwise all-gather-matmul (call inside ``shard_map``).
+
+    ``x``: this shard's slice along ``chunk_axis``; ``w``: this shard's
+    column split ``[K, M_local]``. Returns ``y = gather(x) @ w`` (gathered
+    along ``chunk_axis``, still column-split), and with
+    ``return_full=True`` also the assembled gather of ``x`` itself — for
+    consumers that share the streamed chunks (the fused QKV projection)
+    without paying a second ring.
+
+    Backward: the activation gradient is the transpose schedule
+    (``matmul_reduce_scatter`` of ``dy @ w^T``, folding the full-copy
+    cotangent into the same rotating accumulators) and ``dw`` accumulates
+    blockwise while the chunks stream again — the gathered ``x`` is never
+    saved.
+    """
+    y, full, _ = _stream_ring(
+        x, axis_name, chunk_axis, w=w, return_full=return_full,
+        precision=precision,
+    )
+    return (y, full) if return_full else y
+
+
+def _agm_fwd(x, w, axis_name, chunk_axis, return_full, precision):
+    y, full, _ = _stream_ring(
+        x, axis_name, chunk_axis, w=w, return_full=return_full,
+        precision=precision,
+    )
+    return ((y, full) if return_full else y), (x, w)
+
+
+def _agm_bwd(axis_name, chunk_axis, return_full, precision, res, ct):
+    x, w = res
+    dy, dfull = ct if return_full else (ct, None)
+    # dw rides a fresh chunk stream (the backward's re-gather — gathered x
+    # is never a residual); dx is the sibling op's rotating ring over
+    # dy @ w^T, with the gathered-copy cotangent summed into the same
+    # accumulators (its transpose is exactly a reduce-scatter).
+    _, _, dw = _stream_ring(
+        x, axis_name, chunk_axis, stationary=dy, wgrad_order="lhs",
+        precision=precision,
+    )
+    dx = _rotating_ring(
+        dy, w.T, axis_name, chunk_axis, extra=dfull, precision=precision
+    )
+    return dx.astype(x.dtype), dw.astype(w.dtype)
+
+
+all_gather_matmul.defvjp(_agm_fwd, _agm_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def matmul_reduce_scatter(y, w, axis_name, chunk_axis, precision=None):
+    """Per-shard blockwise matmul-reduce-scatter (call inside ``shard_map``).
+
+    ``y``: gathered-along-``chunk_axis``, feature-split ``[..., M_local]``
+    input; ``w``: this shard's row split ``[M_local, K]``. Returns this
+    shard's chunk of ``sum_shards(y @ w)`` — the Megatron row-parallel
+    output, reduced AND scattered by the rotating ring instead of a
+    monolithic allreduce.
+
+    Backward: ``dy`` is the sibling ``all_gather_matmul`` schedule over the
+    incoming chunk cotangents times ``w^T``, and ``dw`` accumulates
+    blockwise against the SAME streamed chunks — one ring serves both.
+    """
+    return _rotating_ring(y, w, axis_name, chunk_axis, precision=precision)
+
+
+def _mrs_fwd(y, w, axis_name, chunk_axis, precision):
+    return (
+        _rotating_ring(y, w, axis_name, chunk_axis, precision=precision),
+        (y, w),
+    )
+
+
+def _mrs_bwd(axis_name, chunk_axis, precision, res, dz):
+    y, w = res
+    dy, _, dw = _stream_ring(
+        dz,
+        axis_name,
+        chunk_axis,
+        w=w.T,
+        stationary=y,
+        wgrad_order="rhs",
+        precision=precision,
+    )
+    return dy.astype(y.dtype), dw.astype(w.dtype)
+
+
+matmul_reduce_scatter.defvjp(_mrs_fwd, _mrs_bwd)

@@ -124,6 +124,12 @@ class Trainer:
             )
 
             validate_overlap_config(cfg)
+        if cfg.parallel.tp_overlap:
+            from frl_distributed_ml_scaffold_tpu.parallel.tp_overlap import (
+                validate_tp_overlap_config,
+            )
+
+            validate_tp_overlap_config(cfg)
         self.env = mesh_env if mesh_env is not None else build_mesh(cfg.mesh)
         self.policy = get_policy(cfg.precision)
         self.model = create_model(cfg.model, self.policy)
@@ -147,6 +153,11 @@ class Trainer:
             # the (unhooked) model produced the state shapes above; the
             # params tree is identical with hooks on or off.
             self._attach_overlap_hooks()
+        if cfg.parallel.tp_overlap:
+            # Composes with fsdp_overlap: the TP hooks stack onto whichever
+            # model currently backs the loss (the fsdp-hooked clone when
+            # both schedules are on).
+            self._attach_tp_hooks()
         self._compile_steps()
 
     # ---------------------------------------------------------------- setup
@@ -262,6 +273,22 @@ class Trainer:
         # self.model — the params tree is identical either way.
         self._overlap_model = self.model.clone(param_hooks=hooks)
         self.loss_fn = make_loss_fn(self._overlap_model, cfg.data.name)
+
+    def _attach_tp_hooks(self) -> None:
+        """Rebind the loss model to the collective-matmul TP schedule
+        (parallel/tp_overlap.py): the four per-block TP matmuls become
+        latency-hiding ppermute rings. Stacks onto the fsdp_overlap clone
+        when both schedules are on; init/decode keep the plain model (the
+        params tree is identical either way)."""
+        from frl_distributed_ml_scaffold_tpu.parallel.tp_overlap import (
+            make_tp_hooks,
+        )
+
+        cfg = self.cfg
+        hooks = make_tp_hooks(cfg, self.env)
+        base = getattr(self, "_overlap_model", None) or self.model
+        self._tp_model = base.clone(tp_overlap=hooks)
+        self.loss_fn = make_loss_fn(self._tp_model, cfg.data.name)
 
     def _mesh_scoped(self, fn):
         """Run ``fn`` with this trainer's mesh as the ambient context.

@@ -95,12 +95,37 @@ def test_committed_cache_is_corroborated(monkeypatch):
 def test_bench_table_rows_meet_protocol_schema():
     """Every committed protocol row must carry the full measurement
     context: mesh, per-sample FLOPs and MFU (BASELINE.md protocol), plus
-    capture provenance — incomplete rows can't back the stale fallback."""
+    capture provenance — incomplete rows can't back the stale fallback.
+
+    ``status: "queued"`` rows are the one sanctioned exception: they
+    record an experiment awaiting its relay window (BACKLOG R7-1 style)
+    and must carry config/mesh/provenance and a note naming the queued
+    A/B — but NO measurement fields, so a placeholder can never be
+    mistaken for (or corroborate) a measured number."""
     table = os.path.join(REPO_ROOT, "BENCH_TABLE.jsonl")
     rows = [json.loads(l) for l in open(table).read().splitlines() if l.strip()]
     assert rows, "committed BENCH_TABLE.jsonl is empty"
+    assert any(row.get("status") != "queued" for row in rows), (
+        "BENCH_TABLE.jsonl holds only queued placeholders — the stale "
+        "fallback has nothing to corroborate against"
+    )
     for row in rows:
         ctx = f"row for {row.get('config')}"
+        if row.get("status") == "queued":
+            for key in ("config", "mesh", "note"):
+                assert key in row, f"queued {ctx} missing {key}"
+            assert isinstance(row["mesh"], dict) and row["mesh"], ctx
+            for key in ("samples_per_sec_per_chip", "step_time_median_s",
+                        "mfu", "model_flops_per_sample"):
+                assert key not in row, (
+                    f"queued {ctx} carries measurement field {key} — "
+                    "placeholders must not wear measured numbers"
+                )
+            assert bench._row_captured_at(row), (
+                f"queued {ctx} has no provenance (stamp the queue date "
+                "in source/captured_at)"
+            )
+            continue
         for key in ("config", "samples_per_sec_per_chip", "mesh",
                     "model_flops_per_sample", "mfu"):
             assert key in row, f"{ctx} missing {key}"

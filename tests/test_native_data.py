@@ -227,3 +227,50 @@ def test_trainer_uses_prefetching_pipeline(tmp_path):
     # ...and the pipeline transparently re-opens for a second fit.
     _, last2 = trainer.fit(num_steps=8)
     assert last2["loss"] <= last["loss"] + 1e-3
+
+
+def test_prefetch_transfers_on_worker_thread():
+    """A consumed prefetched batch must already be COMMITTED to its
+    devices: the worker runs the full host->device path (shardings_for +
+    placement + the readiness wait), so the consumer thread never pays
+    H2D. Pinned by recording which thread ran the build."""
+    import threading
+
+    import jax
+
+    from frl_distributed_ml_scaffold_tpu.config.schema import DataConfig, MeshConfig
+    from frl_distributed_ml_scaffold_tpu.data.pipeline import (
+        DataPipeline,
+        PrefetchingPipeline,
+    )
+    from frl_distributed_ml_scaffold_tpu.dist.mesh import build_mesh
+
+    env = build_mesh(MeshConfig(data=8))
+    cfg = DataConfig(name="synthetic_mnist", global_batch_size=32)
+    inner = DataPipeline(cfg, env)
+    build_threads: list[str] = []
+    orig = inner.global_batch
+
+    def recording(step):
+        build_threads.append(threading.current_thread().name)
+        return orig(step)
+
+    inner.global_batch = recording
+    pre = PrefetchingPipeline(inner, depth=2)
+    try:
+        pre.global_batch(0)  # primes the prefetch window
+        for fut in list(pre._futures.values()):
+            fut.result()  # let the workers finish before consuming
+        build_threads.clear()
+        batch = pre.global_batch(1)  # prefetched: no consumer-thread build
+        assert build_threads == [] or all(
+            t.startswith("frl-data-prefetch") for t in build_threads
+        ), build_threads
+        for key, arr in batch.items():
+            assert isinstance(arr, jax.Array), key
+            assert arr.committed, f"{key} not committed to devices"
+            assert arr.sharding == inner.shardings_for(
+                {key: np.asarray(jax.device_get(arr))}
+            )[key], key
+    finally:
+        pre.close()

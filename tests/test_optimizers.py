@@ -55,11 +55,15 @@ def test_lion_trains_and_halves_moment_state():
     t = trainer_for("lion")
     state = t.init_state()
     losses = []
-    for step in range(6):
+    for step in range(12):
         state, m = t.train_step(state, t.pipeline.global_batch(step))
         losses.append(float(m["loss"]))
     assert np.isfinite(losses).all()
-    assert losses[-1] < losses[0], losses
+    # Windowed trend, not last < first: lion's sign updates make single
+    # steps noisy at this scale (per-batch loss can tick up within 6
+    # steps on some XLA reduction orders); the 12-step window average is
+    # the robust "it trains" signal.
+    assert np.mean(losses[-3:]) < np.mean(losses[:3]), losses
 
     # One moment vs AdamW's two: the optimizer state is ~half the memory.
     lion_state_n = tree_param_count(state.opt_state)

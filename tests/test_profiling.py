@@ -141,3 +141,49 @@ def test_trace_overlap_summary_zero_duration_comm(capsys):
     overlap_summary(Line(), {1: "fusion.1", 2: "all-gather-start.7"})
     out = capsys.readouterr().out
     assert "no duration" in out
+
+
+def test_trace_overlap_classifies_ppermute_hidden_vs_exposed(capsys):
+    """Per-class overlap classification (the tp_overlap A/B evidence path):
+    a synthetic lane with one collective-permute span fully hidden under
+    compute and one fully exposed must bucket 2 ms hidden / 2 ms exposed
+    under the collective-permute class — and keep the fsdp classes
+    (all-gather here) separately bucketed in the same capture."""
+    from tools.trace_analyze import classify_overlap, overlap_summary
+
+    ms = int(1e9)
+    events = [
+        ("fusion.loop_multiply.9", 0 * ms, 6 * ms),      # compute [0, 6)
+        ("collective-permute-start.1", 1 * ms, 3 * ms),  # hidden  [1, 3)
+        ("collective-permute-done.2", 8 * ms, 10 * ms),  # exposed [8, 10)
+        ("all-gather-fusion.3", 5 * ms, 7 * ms),         # 1 hidden, 1 exposed
+    ]
+    stats = classify_overlap(events)
+    cp = stats["collective-permute"]
+    assert cp["total_ms"] == pytest.approx(4.0)
+    assert cp["hidden_ms"] == pytest.approx(2.0)
+    assert cp["exposed_ms"] == pytest.approx(2.0)
+    ag = stats["all-gather"]
+    assert ag["hidden_ms"] == pytest.approx(1.0)
+    assert ag["exposed_ms"] == pytest.approx(1.0)
+    assert stats["all"]["total_ms"] == pytest.approx(6.0)
+    assert stats["all"]["hidden_ms"] == pytest.approx(3.0)
+
+    # The printed summary carries the per-class lines.
+    class E:
+        def __init__(self, mid, start, end):
+            self.metadata_id = mid
+            self.offset_ps = start
+            self.duration_ps = end - start
+
+    lane_events = [E(i, a, b) for i, (_, a, b) in enumerate(events)]
+
+    class Line:
+        pass
+
+    Line.events = lane_events
+    emeta = {i: name for i, (name, _, _) in enumerate(events)}
+    overlap_summary(Line(), emeta)
+    out = capsys.readouterr().out
+    assert "collective-permute: 4.00 ms, 2.00 hidden / 2.00 exposed" in out
+    assert "all-gather: 2.00 ms, 1.00 hidden / 1.00 exposed" in out

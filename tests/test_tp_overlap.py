@@ -1,0 +1,417 @@
+"""Latency-hiding tensor parallelism (parallel/tp_overlap.py +
+ops/collective_matmul.py): the collective-matmul schedule must (i) match
+the plain GSPMD TP path numerically on every mesh composition, (ii) run
+BLOCKWISE — ppermute-chained per-shard matmuls inside the scan body, with
+no monolithic all-gather of activations anywhere in the step — and (iii)
+refuse configs it cannot honor."""
+
+# The core gates here ride the `fast` tier where marked; the extended
+# mesh-x-remat equivalence matrix is `slow` (COVERAGE.md "Test tiers") —
+# the representative compositions below already cover each dimension.
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from frl_distributed_ml_scaffold_tpu.config import apply_overrides, get_config
+from frl_distributed_ml_scaffold_tpu.dist.mesh import build_mesh, mesh_context
+from frl_distributed_ml_scaffold_tpu.trainer.loop import Trainer
+
+GPT_TINY = [
+    "model.num_layers=2", "model.num_heads=4", "model.hidden_dim=64",
+    "model.seq_len=64", "model.vocab_size=256",
+    "data.seq_len=64", "data.vocab_size=256",
+    "data.global_batch_size=16",
+    "trainer.grad_accum=1", "trainer.remat=none",
+    "trainer.log_every=1000000",
+    "precision.policy=fp32",
+    "checkpoint.enabled=false",
+    "optimizer.warmup_steps=0",
+]
+
+VIT_TINY = [
+    "model.image_size=32", "model.patch_size=8", "model.hidden_dim=64",
+    "model.num_layers=2", "model.num_heads=4", "model.num_classes=10",
+    "data.name=synthetic_imagenet", "data.image_size=32",
+    "data.num_classes=10", "data.global_batch_size=16",
+    "trainer.grad_accum=1", "trainer.remat=none",
+    "trainer.log_every=1000000",
+    "precision.policy=fp32",
+    "checkpoint.enabled=false",
+    "optimizer.warmup_steps=0",
+]
+
+
+def make_trainer(name, base, overrides, tmp_path):
+    cfg = apply_overrides(
+        get_config(name), base + [f"workdir={tmp_path}"] + list(overrides)
+    )
+    env = build_mesh(cfg.mesh)
+    return Trainer(cfg, mesh_env=env)
+
+
+def run_steps(trainer, n=3):
+    state = trainer.init_state()
+    for step in range(n):
+        state, metrics = trainer.train_step(
+            state, trainer.pipeline.global_batch(step)
+        )
+    return jax.device_get(state), jax.device_get(metrics)
+
+
+def assert_params_close(a, b, atol=2e-3):
+    """steps x lr tolerance (the test_fsdp_overlap.py discipline): adamw
+    amplifies numerically-zero grads (e.g. attn/key/bias) into lr-scale
+    sign updates from float noise, and the ring reorders those reductions
+    vs GSPMD's allreduce. Losses are compared tightly where asserted."""
+    jax.tree.map(
+        lambda x, y: np.testing.assert_allclose(x, y, atol=atol, rtol=1e-4),
+        a.params,
+        b.params,
+    )
+
+
+def gpt_pair(tmp_path, mesh, extra=()):
+    """(GSPMD-TP state+metrics, tp_overlap state+metrics) after 3 steps."""
+    ref = make_trainer(
+        "gpt2_tp", GPT_TINY, mesh + list(extra), tmp_path / "ref"
+    )
+    ovl = make_trainer(
+        "gpt2_medium_tp_overlap", GPT_TINY, mesh + list(extra),
+        tmp_path / "ovl",
+    )
+    return run_steps(ref), run_steps(ovl)
+
+
+def test_tp_overlap_matches_model_only_mesh(tmp_path):
+    """model=8: the pure-TP mesh, plus a sharding sanity check — the
+    overlap config must still Megatron-shard the kernels (a silently
+    replicated run would also 'match')."""
+    (ref, ref_m), (ovl, ovl_m) = gpt_pair(
+        tmp_path, ["mesh.data=1", "mesh.model=8"]
+    )
+    assert_params_close(ref, ovl)
+    np.testing.assert_allclose(ovl_m["loss"], ref_m["loss"], atol=1e-5)
+    t = make_trainer(
+        "gpt2_medium_tp_overlap", GPT_TINY,
+        ["mesh.data=1", "mesh.model=8"], tmp_path / "shard",
+    )
+    state = t.init_state()
+    qk = state.params["blocks"]["attn"]["query"]["kernel"]
+    assert any(
+        e == "model" or (isinstance(e, tuple) and "model" in e)
+        for e in qk.sharding.spec
+    ), qk.sharding.spec
+
+
+def test_tp_overlap_matches_data_x_model(tmp_path):
+    """data=2 x model=4: the hybrid mesh of the acceptance gate."""
+    (ref, _), (ovl, _) = gpt_pair(tmp_path, ["mesh.data=2", "mesh.model=4"])
+    assert_params_close(ref, ovl)
+
+
+def test_tp_overlap_matches_fsdp_x_model(tmp_path):
+    """data=2 x fsdp=2 x model=2 with params fsdp-sharded: the rings must
+    compose with GSPMD's fsdp gathers of the weight shards."""
+    extra = [
+        "parallel.param_sharding=fsdp", "parallel.opt_sharding=like_params",
+        "parallel.fsdp_min_size=16",
+    ]
+    (ref, _), (ovl, _) = gpt_pair(
+        tmp_path, ["mesh.data=2", "mesh.fsdp=2", "mesh.model=2"], extra
+    )
+    assert_params_close(ref, ovl)
+
+
+def test_tp_overlap_composes_with_fsdp_overlap(tmp_path):
+    """BOTH explicit schedules at once (the composition the ISSUE names):
+    fsdp_overlap's per-block param gathers + tp_overlap's collective
+    matmuls, vs the all-GSPMD path on the same fsdp x model mesh."""
+    mesh = ["mesh.data=1", "mesh.fsdp=4", "mesh.model=2"]
+    ref = make_trainer(
+        "gpt2_tp", GPT_TINY,
+        mesh + ["parallel.param_sharding=fsdp",
+                "parallel.opt_sharding=like_params",
+                "parallel.fsdp_min_size=16"],
+        tmp_path / "ref",
+    )
+    ovl = make_trainer(
+        "gpt2_medium_fsdp_overlap", GPT_TINY,
+        mesh + ["parallel.tp_overlap=true", "parallel.fsdp_min_size=16"],
+        tmp_path / "ovl",
+    )
+    (ref_s, _), (ovl_s, _) = run_steps(ref), run_steps(ovl)
+    assert_params_close(ref_s, ovl_s)
+
+
+def test_tp_overlap_grad_accum_matches(tmp_path):
+    """grad_accum=4: the rings run inside the microbatch scan body."""
+    (ref, _), (ovl, _) = gpt_pair(
+        tmp_path, ["mesh.data=2", "mesh.model=4"],
+        extra=["trainer.grad_accum=4"],
+    )
+    assert_params_close(ref, ovl)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("block_remat", ["full", "save_attn"])
+def test_tp_overlap_block_remat_interaction(tmp_path, block_remat):
+    """Per-block remat modes: the rings sit inside the remat region, so
+    the backward re-runs them instead of saving gathered activations."""
+    (ref, _), (ovl, _) = gpt_pair(
+        tmp_path, ["mesh.data=2", "mesh.model=4"],
+        extra=[f"model.block_remat={block_remat}"],
+    )
+    assert_params_close(ref, ovl)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("remat", ["full", "dots"])
+def test_tp_overlap_trainer_remat_interaction(tmp_path, remat):
+    """Whole-loss checkpoint modes around the hooked model."""
+    (ref, _), (ovl, _) = gpt_pair(
+        tmp_path, ["mesh.data=2", "mesh.model=4"],
+        extra=[f"trainer.remat={remat}"],
+    )
+    assert_params_close(ref, ovl)
+
+
+def test_vit_tp_overlap_matches(tmp_path):
+    """ViT equivalents (flax MHA qkv/out dot_general injection + MlpBlock),
+    batch-chunked rings: tp_overlap == GSPMD TP on data x model."""
+    mesh = [
+        "mesh.data=2", "mesh.model=4", "parallel.param_sharding=replicated",
+    ]
+    ref = make_trainer(
+        "imagenet_vitb_fsdp", VIT_TINY, mesh, tmp_path / "ref"
+    )
+    ovl = make_trainer(
+        "imagenet_vitb_fsdp", VIT_TINY,
+        mesh + ["parallel.tp_overlap=true"], tmp_path / "ovl",
+    )
+    (ref_s, ref_m), (ovl_s, ovl_m) = run_steps(ref), run_steps(ovl)
+    assert_params_close(ref_s, ovl_s)
+    np.testing.assert_allclose(ovl_m["loss"], ref_m["loss"], atol=1e-5)
+
+
+# --------------------------------------------------------------- blockwise
+
+
+def _walk_jaxpr(jaxpr, prim_name, found):
+    """Collect output shapes of every ``prim_name`` eqn, recursing into
+    sub-jaxprs (scan bodies, remat/custom_vjp calls, shard_map regions)."""
+    for eqn in jaxpr.eqns:
+        if prim_name in str(eqn.primitive):
+            found.append(tuple(v.aval.shape for v in eqn.outvars))
+        for v in eqn.params.values():
+            vs = v if isinstance(v, (list, tuple)) else [v]
+            for u in vs:
+                if hasattr(u, "eqns"):
+                    _walk_jaxpr(u, prim_name, found)
+                elif hasattr(u, "jaxpr") and hasattr(u.jaxpr, "eqns"):
+                    _walk_jaxpr(u.jaxpr, prim_name, found)
+    return found
+
+
+def _step_jaxpr(t):
+    state = t.init_state()
+    batch = t.pipeline.global_batch(0)
+    with mesh_context(t.env):
+        return jax.make_jaxpr(t._train_step_fn)(state, batch), state
+
+
+@pytest.mark.fast
+@pytest.mark.parametrize("policy", ["fp32", "bf16_mixed"])
+def test_tp_overlap_schedule_is_blockwise_ppermute(tmp_path, policy):
+    """The jaxpr pin of the acceptance gate: the step must carry blockwise
+    ppermute chains INSIDE the layer-scan body (forward and backward), and
+    NO monolithic all_gather of activations — on the pure-TP config there
+    is no all_gather primitive in the step at all.
+
+    Parametrized over the precision policy because the shared-QKV ring
+    cache keys on input-object identity: under bf16_mixed the fp32
+    LayerNorm output is pre-cast once in the attention block precisely so
+    the trio still shares ONE ring — this pin is what keeps that from
+    silently regressing to three."""
+    m = 4
+    t = make_trainer(
+        "gpt2_medium_tp_overlap", GPT_TINY,
+        ["mesh.data=2", f"mesh.model={m}", f"precision.policy={policy}"],
+        tmp_path,
+    )
+    jaxpr, _ = _step_jaxpr(t)
+
+    assert not _walk_jaxpr(jaxpr.jaxpr, "all_gather", []), (
+        "tp_overlap step contains an explicit all_gather — the activation "
+        "gather is supposed to be a blockwise ppermute ring"
+    )
+    total = _walk_jaxpr(jaxpr.jaxpr, "ppermute", [])
+    assert total, "tp_overlap produced no ppermute chains"
+
+    # Per layer-scan iteration: 4 rings (shared-QKV gather, fc_in gather,
+    # attn-out scatter, fc_out scatter), each a bidirectional chain of
+    # 2*(m-1) hops. The scan bodies must carry them — that's what makes
+    # the schedule per-block; the backward scan carries its own.
+    scan_counts = []
+    for eqn in jaxpr.jaxpr.eqns:
+        if str(eqn.primitive) == "scan":
+            scan_counts.append(
+                len(_walk_jaxpr(eqn.params["jaxpr"].jaxpr, "ppermute", []))
+            )
+    with_rings = [n for n in scan_counts if n > 0]
+    assert len(with_rings) >= 2, (
+        "expected ppermute chains inside both the forward and backward "
+        f"layer scans (scan ppermute counts: {scan_counts})"
+    )
+    assert max(with_rings) >= 4 * 2 * (m - 1), scan_counts
+    # The QKV trio shares ONE gather ring: 4 rings/block forward, not 6.
+    assert min(with_rings) == 4 * 2 * (m - 1), (
+        "forward scan ppermute count does not match the shared-QKV "
+        f"4-ring schedule (scan counts: {scan_counts})"
+    )
+
+
+@pytest.mark.fast
+def test_tp_overlap_no_activation_gather_under_fsdp(tmp_path):
+    """Composed with explicit-FSDP gathers: every all_gather in the step
+    must be a PARAM-slice gather (the fsdp_overlap schedule), never an
+    activation — activations ride the ppermute rings."""
+    t = make_trainer(
+        "gpt2_medium_fsdp_overlap", GPT_TINY,
+        ["mesh.data=1", "mesh.fsdp=4", "mesh.model=2",
+         "parallel.tp_overlap=true", "parallel.fsdp_min_size=16"],
+        tmp_path,
+    )
+    jaxpr, state = _step_jaxpr(t)
+    gathers = _walk_jaxpr(jaxpr.jaxpr, "all_gather", [])
+    assert gathers, "fsdp_overlap composition lost its explicit param gathers"
+    # The param gathers run inside shard_map, so their jaxpr-level output
+    # shapes are per-shard views: a per-block slice with its Megatron-split
+    # dim still divided by the model axis.
+    m = 2
+    param_slices = set()
+    for l in jax.tree.leaves(state.params["blocks"]):
+        s = tuple(l.shape[1:])
+        param_slices.add(s)
+        for i, d in enumerate(s):
+            if d % m == 0:
+                param_slices.add(s[:i] + (d // m,) + s[i + 1 :])
+    for out_shapes in gathers:
+        for shape in out_shapes:
+            assert shape in param_slices, (
+                f"all_gather output {shape} is not a per-block param slice "
+                "— an activation passed through a monolithic gather"
+            )
+    assert _walk_jaxpr(jaxpr.jaxpr, "ppermute", []), (
+        "composed schedule lost its ppermute rings"
+    )
+
+
+# ------------------------------------------------------------- validation
+
+
+@pytest.mark.fast
+def test_tp_overlap_requires_model_axis(tmp_path):
+    with pytest.raises(ValueError, match="mesh.model"):
+        make_trainer(
+            "gpt2_medium_tp_overlap", GPT_TINY,
+            ["mesh.data=8", "mesh.model=1"], tmp_path,
+        )
+
+
+@pytest.mark.fast
+def test_tp_overlap_refuses_pipeline(tmp_path):
+    with pytest.raises(ValueError, match="pipeline"):
+        make_trainer(
+            "gpt2_medium_tp_overlap", GPT_TINY,
+            ["mesh.data=2", "mesh.model=2", "mesh.pipe=2",
+             "model.num_layers=4", "model.pipeline_stages=2"],
+            tmp_path,
+        )
+
+
+@pytest.mark.fast
+def test_tp_overlap_refuses_sequence_parallel(tmp_path):
+    with pytest.raises(ValueError, match="sequence"):
+        make_trainer(
+            "gpt2_medium_tp_overlap", GPT_TINY,
+            ["mesh.data=2", "mesh.model=2", "mesh.seq=2",
+             "model.attention=ring", "parallel.sequence=ring"],
+            tmp_path,
+        )
+
+
+@pytest.mark.fast
+def test_tp_overlap_refuses_moe(tmp_path):
+    with pytest.raises(ValueError, match="[Mm]oE"):
+        make_trainer(
+            "gpt2_medium_tp_overlap", GPT_TINY,
+            ["mesh.data=2", "mesh.model=4", "model.moe.num_experts=4"],
+            tmp_path,
+        )
+
+
+@pytest.mark.fast
+def test_tp_overlap_refuses_indivisible_hidden_dim(tmp_path):
+    """Indivisible Megatron feature dims must fail at validation (GSPMD
+    pads uneven shards; the rings split exactly), not as a shard_map
+    trace error."""
+    with pytest.raises(ValueError, match="hidden_dim"):
+        make_trainer(
+            "gpt2_medium_tp_overlap", GPT_TINY,
+            ["mesh.data=1", "mesh.model=8", "model.hidden_dim=60",
+             "model.num_heads=4"],
+            tmp_path,
+        )
+
+
+@pytest.mark.fast
+def test_tp_overlap_refuses_indivisible_seq(tmp_path):
+    with pytest.raises(ValueError, match="seq_len"):
+        make_trainer(
+            "gpt2_medium_tp_overlap", GPT_TINY,
+            ["mesh.data=1", "mesh.model=8", "model.seq_len=60",
+             "data.seq_len=60"],
+            tmp_path,
+        )
+
+
+@pytest.mark.fast
+def test_tp_overlap_refuses_unsupported_family(tmp_path):
+    with pytest.raises(ValueError, match="family"):
+        make_trainer(
+            "imagenet_rn50_ddp",
+            ["data.name=synthetic_imagenet", "data.image_size=32",
+             "data.num_classes=10", "data.global_batch_size=16",
+             "model.depth=10", "model.num_classes=10",
+             "checkpoint.enabled=false", "trainer.log_every=1000000"],
+            ["mesh.data=8", "parallel.tp_overlap=true"],
+            tmp_path,
+        )
+
+
+def test_tp_overlap_parity_dryrun_style(tmp_path):
+    """dryrun_multichip-style parity: first-step loss of the composed
+    data x model overlap mesh agrees with the same config on one device
+    (tol 2e-2, the driver's parity band)."""
+    ovl = make_trainer(
+        "gpt2_medium_tp_overlap", GPT_TINY,
+        ["mesh.data=2", "mesh.model=4"], tmp_path / "multi",
+    )
+    state = ovl.init_state()
+    _, m_multi = ovl.train_step(state, ovl.pipeline.global_batch(0))
+
+    cfg1 = apply_overrides(
+        get_config("gpt2_medium_zero1"),
+        GPT_TINY + [f"workdir={tmp_path}/single", "mesh.data=1", "mesh.fsdp=1"],
+    )
+    env1 = build_mesh(cfg1.mesh, devices=jax.devices()[:1])
+    single = Trainer(cfg1, mesh_env=env1)
+    s1 = single.init_state()
+    _, m_single = single.train_step(s1, single.pipeline.global_batch(0))
+    l_multi, l_single = float(m_multi["loss"]), float(m_single["loss"])
+    assert abs(l_multi - l_single) <= 2e-2 * max(1.0, abs(l_single)), (
+        l_multi, l_single,
+    )

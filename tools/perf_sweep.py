@@ -339,6 +339,46 @@ def gpt2_fsdp_overlap():
             )
 
 
+def gpt2_tp_overlap():
+    """Round-7 A/B, queued for the next multi-chip relay window (BACKLOG
+    R7): latency-hiding tensor parallelism (parallel.tp_overlap — the
+    collective-matmul ppermute rings of ops/collective_matmul.py) vs the
+    plain GSPMD TP schedule, at the gpt2_medium_tp_overlap operating
+    point. Needs >= 2 devices for a real model axis; on the single-chip
+    relay it emits a skip row instead of a meaningless comm-free "A/B".
+    Correctness is already sim-gated (tests/test_tp_overlap.py); this
+    measures whether the rings actually hide the per-layer model-axis
+    comm — capture a trace alongside and read tools/trace_analyze.py's
+    per-class overlap summary (collective-permute hidden vs exposed)."""
+    import jax
+
+    n = jax.device_count()
+    if n < 2:
+        print(json.dumps({
+            "experiment": "gpt2_tp_overlap",
+            "skipped": f"needs >=2 devices for a model axis (have {n})",
+        }), flush=True)
+        return
+    base = [
+        "trainer.grad_accum=1",
+        "trainer.remat=none",
+        "model.block_remat=full",
+        "mesh.data=1",
+        f"mesh.model={n}",
+    ]
+    for overlap in ("false", "true"):
+        for global_bs in (8, 16):
+            measure_or_emit(
+                "gpt2_tp_overlap", global_bs, "gpt2_medium_tp_overlap",
+                base + [
+                    f"parallel.tp_overlap={overlap}",
+                    f"data.global_batch_size={global_bs}",
+                ],
+                {"tp_overlap": overlap, "n_chips": n},
+                n=10, warm=3,
+            )
+
+
 def moe_dispatch():
     """Round-5 A/B the FLOP table predicts sort wins (einsum exchange =
     66% of step FLOPs at the audited shapes; sort cuts total 1.79x —
@@ -420,7 +460,8 @@ GROUPS = {f.__name__: f for f in (rn50_bs, rn50_precision, rn50_fwd_only,
                                   rn50_headline, rn50_pool, gpt2_opt,
                                   gpt2_block_remat, gpt2_offload,
                                   rn50_fused_opt, rn50_fused_bn,
-                                  moe_dispatch, gpt2_fsdp_overlap)}
+                                  moe_dispatch, gpt2_fsdp_overlap,
+                                  gpt2_tp_overlap)}
 
 if __name__ == "__main__":
     which = sys.argv[1:] or list(GROUPS)

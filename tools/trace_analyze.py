@@ -33,8 +33,22 @@ def find_xplane(root: str) -> str:
 
 #: Op-name substrings that classify an XLA op as communication. The
 #: overlap summary keys on these (fusion names embed the collective name).
+#: Order matters: an op is bucketed under its FIRST match, so the
+#: per-class breakdown stays deterministic for fusion names embedding
+#: several (e.g. a fused reduce-scatter feeding a collective-permute).
 COMM_OPS = ("all-gather", "all-reduce", "reduce-scatter",
             "collective-permute", "all-to-all")
+
+
+def comm_class(name: str) -> str | None:
+    """First COMM_OPS substring in ``name``, or None for compute ops.
+
+    ``collective-permute`` is what the tp_overlap / collective-matmul
+    ppermute rings lower to — the class the gpt2_tp_overlap A/B reads."""
+    for k in COMM_OPS:
+        if k in name:
+            return k
+    return None
 
 
 def _merge(intervals):
@@ -63,41 +77,88 @@ def _intersection_len(xs, ys):
     return total
 
 
-def overlap_summary(line, emeta) -> None:
-    """Comm-vs-compute overlap evidence for one device timeline.
+def classify_overlap(events) -> dict:
+    """Comm-vs-compute overlap stats for one device timeline.
 
-    The number the overlap-scheduled FSDP A/B is after (perf_sweep
-    gpt2_fsdp_overlap / docs/perf_playbook.md): how much collective time
-    runs CONCURRENTLY with compute vs exposed on the critical path.
-    Computed as an interval sweep over the XLA Ops lane: union the comm
-    events' wall intervals, union the compute events', intersect.
+    ``events``: iterable of ``(name, start_ps, end_ps)`` spans (pure data —
+    tests feed synthetic spans, ``main`` feeds the XLA Ops lane). Returns
+    ``{"all": {...}, "<comm class>": {...}}`` where each value carries
+    ``total_ms`` / ``hidden_ms`` / ``exposed_ms``: comm intervals are
+    unioned (per class and overall), compute intervals unioned, and hidden
+    time is their intersection — collective time running CONCURRENTLY with
+    compute vs exposed on the critical path. The per-class split is what
+    separates the FSDP schedule's all-gather/reduce-scatter from the
+    tp_overlap rings' collective-permute in one capture.
     """
-    comm, comp = [], []
-    for e in line.events:
-        name = emeta[e.metadata_id]
-        iv = (e.offset_ps, e.offset_ps + e.duration_ps)
-        if any(k in name for k in COMM_OPS):
-            comm.append(iv)
+    comp = []
+    by_class: dict[str, list] = {}
+    for name, a, b in events:
+        cls = comm_class(name)
+        if cls is None:
+            comp.append((a, b))
         else:
-            comp.append(iv)
-    if not comm:
+            by_class.setdefault(cls, []).append((a, b))
+    comp_m = _merge(comp)
+    out = {}
+    all_comm = []
+    for cls, ivs in by_class.items():
+        merged = _merge(ivs)
+        all_comm.extend(ivs)
+        total = sum(b - a for a, b in merged)
+        hidden = _intersection_len(merged, comp_m)
+        out[cls] = {
+            "total_ms": total / 1e9,
+            "hidden_ms": hidden / 1e9,
+            "exposed_ms": (total - hidden) / 1e9,
+        }
+    if all_comm:
+        merged = _merge(all_comm)
+        total = sum(b - a for a, b in merged)
+        hidden = _intersection_len(merged, comp_m)
+        out["all"] = {
+            "total_ms": total / 1e9,
+            "hidden_ms": hidden / 1e9,
+            "exposed_ms": (total - hidden) / 1e9,
+        }
+    return out
+
+
+def overlap_summary(line, emeta) -> None:
+    """Print the overlap evidence for one XLA Ops lane (the number the
+    overlap-schedule A/Bs are after — perf_sweep gpt2_fsdp_overlap /
+    gpt2_tp_overlap, docs/perf_playbook.md)."""
+    events = [
+        (
+            emeta[e.metadata_id],
+            e.offset_ps,
+            e.offset_ps + e.duration_ps,
+        )
+        for e in line.events
+    ]
+    stats = classify_overlap(events)
+    if not stats:
         print("  overlap: no collective ops in this lane")
         return
-    comm_m, comp_m = _merge(comm), _merge(comp)
-    comm_ms = sum(b - a for a, b in comm_m) / 1e9
-    if comm_ms <= 0.0:
+    if stats["all"]["total_ms"] <= 0.0:
         # Async collective pairs can log zero-duration start/done marker
         # events; a lane with only those has no measurable comm window.
         print("  overlap: collective events carry no duration in this lane")
         return
-    hidden_ms = _intersection_len(comm_m, comp_m) / 1e9
-    exposed_ms = comm_ms - hidden_ms
+    agg = stats["all"]
     print(
-        f"  overlap: comm {comm_ms:.2f} ms total, "
-        f"{hidden_ms:.2f} ms hidden under compute "
-        f"({100.0 * hidden_ms / comm_ms:.1f}%), "
-        f"{exposed_ms:.2f} ms exposed"
+        f"  overlap: comm {agg['total_ms']:.2f} ms total, "
+        f"{agg['hidden_ms']:.2f} ms hidden under compute "
+        f"({100.0 * agg['hidden_ms'] / agg['total_ms']:.1f}%), "
+        f"{agg['exposed_ms']:.2f} ms exposed"
     )
+    for cls in COMM_OPS:
+        s = stats.get(cls)
+        if s is None or s["total_ms"] <= 0.0:
+            continue
+        print(
+            f"    {cls:>18s}: {s['total_ms']:.2f} ms, "
+            f"{s['hidden_ms']:.2f} hidden / {s['exposed_ms']:.2f} exposed"
+        )
 
 
 def main() -> int:
