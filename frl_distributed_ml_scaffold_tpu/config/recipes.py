@@ -349,3 +349,21 @@ def imagenet_vitl_fsdp() -> ExperimentConfig:
             base.model, hidden_dim=1024, num_layers=24, num_heads=16
         ),
     )
+
+
+@register_config("gpt2_medium_serve")
+def gpt2_medium_serve() -> ExperimentConfig:
+    """Flash-decode serving operating point (the BACKLOG R8-1 on-chip
+    A/B): the gpt2_medium flagship weights served through
+    ``serving/engine.py`` with the fused split-KV decode kernel
+    (``model.decode_attention=flash``, the default) and the KV cache
+    model-sharded over a 2-way ``model`` axis. ``tools/serve_bench.py``
+    measures the four (decode impl x cache sharding) arms; this recipe
+    records the mesh/model shape those arms load."""
+    base = gpt2_medium_zero1()
+    return base.replace(
+        name="gpt2_medium_serve",
+        model=dataclasses.replace(base.model, decode_attention="flash"),
+        mesh=MeshConfig(data=-1, fsdp=1, model=2),
+        parallel=ParallelConfig(param_sharding="replicated"),
+    )

@@ -343,6 +343,13 @@ class GPTConfig:
     layer_norm_epsilon: float = 1e-5
     # Attention implementation: "dense" | "ring" | "ulysses" | "flash"
     attention: str = "dense"
+    # KV-cache decode attention: "flash" routes single-token steps through
+    # the fused split-KV Pallas kernel (ops/decode_attention.py; on
+    # non-TPU backends it silently takes the identical-numerics dense
+    # path, same contract as attention="flash"), "dense" forces the
+    # masked-dense reference. Orthogonal to ``attention`` — the training
+    # kernels are pointless at one-token query shapes.
+    decode_attention: str = "flash"
     # Chunked-vocab LM loss: compute the weight-tied head + cross-entropy
     # in sequence chunks of this many tokens (rematerialized in backward),
     # so the [B, T, vocab] logits tensor never materializes — for

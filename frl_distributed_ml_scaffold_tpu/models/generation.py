@@ -55,6 +55,66 @@ def _sample(
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
+def next_cache_bucket(seq_len: int, needed: int, floor: int = 8) -> int:
+    """The serving bucket policy: smallest power of two >= ``needed``
+    (and >= ``floor``), with ``seq_len`` itself as the terminal bucket.
+    Powers of two keep the number of distinct compiled decode programs at
+    log2(seq_len) while short requests stop paying full-context cache
+    traffic."""
+    if needed > seq_len:
+        raise ValueError(f"needed cache {needed} exceeds seq_len {seq_len}")
+    b = 1 << max(needed, floor, 1).bit_length()
+    if b // 2 >= max(needed, floor, 1):
+        b //= 2
+    return min(b, seq_len)
+
+
+def _bucketed(model: Any, cache_len: int | None, needed: int) -> Any:
+    """Clone the model with its decode cache sized to the active bucket
+    (``cache_len=None`` = auto policy; pass ``model.config.seq_len`` for
+    the legacy full-context cache)."""
+    if cache_len is None:
+        cache_len = next_cache_bucket(model.config.seq_len, needed)
+    if cache_len < needed:
+        raise ValueError(
+            f"cache_len={cache_len} cannot hold prompt+new={needed} tokens"
+        )
+    return model.clone(cache_len=int(cache_len))
+
+
+def _take_logits(out):
+    """MoE models return (logits, aux) tuples from apply."""
+    return out[0] if isinstance(out, tuple) else out
+
+
+def _prefill(model: Any, params: Any, prompt: jax.Array,
+             lengths: jax.Array | None):
+    """One pass over the (possibly left-padded ragged) prompt creates +
+    fills every layer's KV cache; returns (last-position logits [B, V],
+    cache). Prompts are right-aligned, so logits[:, -1] is every row's
+    real last token regardless of raggedness. The SHARED decode entry:
+    generate and beam_search both start here, so they cannot drift."""
+    logits, vars_out = model.apply(
+        {"params": params}, prompt, decode=True, lengths=lengths,
+        mutable=["cache"],
+    )
+    return _take_logits(logits)[:, -1], vars_out["cache"]
+
+
+def _decode_step(model: Any, params: Any, cache: Any, tok: jax.Array):
+    """One single-token decode step for every row: returns (logits [B, V],
+    updated cache). The SHARED step generate and beam_search scan over —
+    both therefore route through the same ops/decode_attention entry
+    point (flash-decode kernel or dense, per config.decode_attention)."""
+    logits, vars_out = model.apply(
+        {"params": params, "cache": cache},
+        tok[:, None],
+        decode=True,
+        mutable=["cache"],
+    )
+    return _take_logits(logits)[:, 0], vars_out["cache"]
+
+
 def _plain_stack(model: Any, params: Any) -> tuple[Any, Any]:
     """Decode always runs on the plain layer stack: a pipeline-trained
     model (``pipeline_stages > 1``) is swapped for its ``stages=1`` twin
@@ -91,6 +151,8 @@ def generate(
     top_p: float = 0.0,
     eos_id: int | None = None,
     rng: jax.Array | None = None,
+    prompt_lengths: jax.Array | None = None,
+    cache_len: int | None = None,
 ) -> jax.Array:
     """Sample ``max_new_tokens`` continuations of ``prompt`` ([B, Tp] int).
 
@@ -99,6 +161,15 @@ def generate(
     ``max_new_tokens``/``temperature``/``top_k``/``top_p`` stay static — wrap with
     ``jax.jit(partial(generate, model, ...), static_argnames=...)`` or just
     call it; the two inner ``apply`` calls are where the time goes.
+
+    Ragged batches: pass LEFT-padded prompts (real tokens right-aligned)
+    plus ``prompt_lengths`` [B] — prefill then neither attends over nor
+    caches the pad columns, so mixed-length batches are first-class.
+
+    The KV cache is bucketed (``next_cache_bucket``) to the smallest
+    power of two covering prompt+budget rather than pre-sized to
+    ``config.seq_len``; pass ``cache_len=config.seq_len`` to force the
+    legacy full-context cache.
     """
     model, params = _plain_stack(model, params)
     cfg = model.config
@@ -108,39 +179,27 @@ def generate(
             f"prompt ({tp}) + max_new_tokens ({max_new_tokens}) exceeds the "
             f"model context ({cfg.seq_len}) — the KV cache is sized to it"
         )
+    # Prefill writes [0, Tp) and rows extend to at most len+new-1 < Tp+new.
+    model = _bucketed(model, cache_len, tp + max_new_tokens)
     rng = jax.random.key(0) if rng is None else rng
     prompt = prompt.astype(jnp.int32)
 
-    # Prefill: one pass over the prompt creates + fills every layer's cache
-    # (flax creates the 'cache' collection lazily because it is mutable).
-    logits, vars_out = model.apply(
-        {"params": params}, prompt, decode=True, mutable=["cache"]
-    )
-    if isinstance(logits, tuple):  # MoE models also return the aux loss
-        logits = logits[0]
-    cache = vars_out["cache"]
+    logits_last, cache = _prefill(model, params, prompt, prompt_lengths)
     rng, sub = jax.random.split(rng)
-    tok = _sample(logits[:, -1], sub, temperature=temperature,
+    tok = _sample(logits_last, sub, temperature=temperature,
                   top_k=top_k, top_p=top_p)
     done = jnp.zeros((b,), bool) if eos_id is None else tok == eos_id
 
     def step(carry, _):
         cache, tok, done, rng = carry
-        logits, vars_out = model.apply(
-            {"params": params, "cache": cache},
-            tok[:, None],
-            decode=True,
-            mutable=["cache"],
-        )
-        if isinstance(logits, tuple):
-            logits = logits[0]
+        logits, cache = _decode_step(model, params, cache, tok)
         rng, sub = jax.random.split(rng)
-        nxt = _sample(logits[:, 0], sub, temperature=temperature,
+        nxt = _sample(logits, sub, temperature=temperature,
                       top_k=top_k, top_p=top_p)
         if eos_id is not None:
             nxt = jnp.where(done, eos_id, nxt)
             done = done | (nxt == eos_id)
-        return (vars_out["cache"], nxt, done, rng), tok
+        return (cache, nxt, done, rng), tok
 
     (_, last, _, _), toks = jax.lax.scan(
         step, (cache, tok, done, rng), None, length=max_new_tokens - 1
@@ -149,21 +208,42 @@ def generate(
     return jnp.concatenate([prompt, new], axis=1)
 
 
-def _gather_cache_rows(cache, rows, batch_rows: int):
-    """Reorder the per-beam KV rows of a decode cache.
+def cache_batch_axis(leaf, batch_rows: int) -> int | None:
+    """THE decode-cache leaf taxonomy, in one place: which axis of a
+    cache leaf carries the request/beam rows. Per-layer K/V stacks
+    ``[L, B, S, H, hd]`` and ``cache_index`` ``[L, B]`` carry them on
+    axis 1; the model-level ``pos_index`` ``[B]`` leads with them; other
+    leaves (none today) carry no rows. Every per-row cache transform —
+    beam gather/repeat here, the serving engine's slot grafts — must
+    agree with this classification, so route through it."""
+    if leaf.ndim >= 2 and leaf.shape[1] == batch_rows:
+        return 1
+    if leaf.ndim == 1 and leaf.shape[0] == batch_rows:
+        return 0
+    return None
 
-    Cache leaves are either per-layer K/V stacks ``[L, B*W, S, H, hd]``
-    (batch on axis 1 — gathered) or batchless bookkeeping (``cache_index``
-    ``[L]``, ``pos_index`` scalar — identical across beams, untouched).
-    """
-    return jax.tree.map(
-        lambda x: (
-            jnp.take(x, rows, axis=1)
-            if x.ndim >= 2 and x.shape[1] == batch_rows
-            else x
-        ),
-        cache,
-    )
+
+def _gather_cache_rows(cache, rows, batch_rows: int):
+    """Reorder the per-beam KV rows of a decode cache. The per-row
+    bookkeeping (``cache_index``, ``pos_index``) MUST follow its beam:
+    under ragged prompts beams of different rows sit at different
+    positions."""
+
+    def leaf(x):
+        ax = cache_batch_axis(x, batch_rows)
+        return x if ax is None else jnp.take(x, rows, axis=ax)
+
+    return jax.tree.map(leaf, cache)
+
+
+def _repeat_cache_rows(cache, w: int, batch_rows: int):
+    """Row-repeat a [B]-batch cache to [B*W] beams."""
+
+    def leaf(x):
+        ax = cache_batch_axis(x, batch_rows)
+        return x if ax is None else jnp.repeat(x, w, axis=ax)
+
+    return jax.tree.map(leaf, cache)
 
 
 def beam_search(
@@ -175,6 +255,8 @@ def beam_search(
     num_beams: int = 4,
     eos_id: int | None = None,
     length_penalty: float = 0.0,
+    prompt_lengths: jax.Array | None = None,
+    cache_len: int | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Deterministic beam decode; returns ``([B, Tp+new] best tokens,
     [B] scores)``.
@@ -211,21 +293,15 @@ def beam_search(
         )
     if w < 1 or w > cfg.vocab_size:
         raise ValueError(f"num_beams={w} not in [1, vocab={cfg.vocab_size}]")
+    model = _bucketed(model, cache_len, tp + max_new_tokens)
     prompt = prompt.astype(jnp.int32)
 
-    logits, vars_out = model.apply(
-        {"params": params}, prompt, decode=True, mutable=["cache"]
-    )
-    if isinstance(logits, tuple):
-        logits = logits[0]
-    lp0 = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32))  # [B, V]
+    # Same shared prefill + decode-step entry as generate(): the beam path
+    # cannot drift from the greedy path's attention numerics.
+    logits_last, cache0 = _prefill(model, params, prompt, prompt_lengths)
+    lp0 = jax.nn.log_softmax(logits_last.astype(jnp.float32))  # [B, V]
     scores, tok = jax.lax.top_k(lp0, w)  # [B, W] each
-    cache = jax.tree.map(
-        lambda x: (
-            jnp.repeat(x, w, axis=1) if x.ndim >= 2 and x.shape[1] == b else x
-        ),
-        vars_out["cache"],
-    )
+    cache = _repeat_cache_rows(cache0, w, b)
     finished = (
         jnp.zeros((b, w), bool) if eos_id is None else tok == eos_id
     )
@@ -235,15 +311,10 @@ def beam_search(
 
     def step(carry, t):
         cache, tok, scores, finished, buf = carry
-        logits, vars_out = model.apply(
-            {"params": params, "cache": cache},
-            tok.reshape(b * w)[:, None],
-            decode=True,
-            mutable=["cache"],
+        logits, new_cache = _decode_step(
+            model, params, cache, tok.reshape(b * w)
         )
-        if isinstance(logits, tuple):
-            logits = logits[0]
-        lp = jax.nn.log_softmax(logits[:, 0].astype(jnp.float32))
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32))
         lp = lp.reshape(b, w, -1)  # [B, W, V]
         if eos_id is not None:
             # Frozen beams may only repeat eos, for free — their score
@@ -257,7 +328,7 @@ def beam_search(
         src = flat_idx // v  # parent beam per survivor [B, W]
         new_tok = (flat_idx % v).astype(jnp.int32)
         rows = (batch_idx * w + src).reshape(-1)
-        cache = _gather_cache_rows(vars_out["cache"], rows, b * w)
+        cache = _gather_cache_rows(new_cache, rows, b * w)
         buf = buf[batch_idx, src]  # reorder histories to surviving beams
         buf = buf.at[:, :, t].set(new_tok)
         finished = finished[batch_idx, src]

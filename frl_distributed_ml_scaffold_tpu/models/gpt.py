@@ -107,16 +107,18 @@ def unstack_pipeline_params(cfg: GPTConfig, params):
 
 
 def _masked_dense_attention(q, k, v, mask):
-    """Dense attention with an explicit [Tq, Tk] mask, fp32 softmax — the
-    same numerics as ops.dense_attention, used by the KV-cache decode path
-    where causality is against *absolute* positions in the cache, not
-    positions within the (length-1) query window."""
+    """Dense attention with an explicit mask ([Tq, Tk] shared or
+    [B, Tq, Tk] per-row), fp32 softmax — the same numerics as
+    ops.dense_attention, used by the KV-cache decode path where causality
+    is against *absolute* positions in the cache, not positions within the
+    query window. The per-row form carries ragged-prompt occupancy."""
     hd = q.shape[-1]
     scores = jnp.einsum(
         "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
     )
     scores = scores / jnp.sqrt(jnp.asarray(hd, jnp.float32))
-    scores = jnp.where(mask[None, None], scores, jnp.finfo(jnp.float32).min)
+    mask = mask[:, None] if mask.ndim == 3 else mask[None, None]
+    scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum(
         "bhqk,bkhd->bqhd",
@@ -125,6 +127,34 @@ def _masked_dense_attention(q, k, v, mask):
         preferred_element_type=jnp.float32,
     )
     return out.astype(q.dtype)
+
+
+def _constrain_kv_cache(x: jnp.ndarray) -> jnp.ndarray:
+    """Pin a [B, S, H, hd] cache leaf model-sharded over the mesh's
+    ``model`` axis (heads split — the Megatron layout the projection
+    kernels already carry), batch over the batch axes when divisible.
+
+    This is what keeps multi-chip serving from silently running the cache
+    replicated: prefill EMITS the cache in this layout and every decode
+    step consumes and re-emits it in the same layout, so no monolithic
+    reshard appears at the prefill->decode handoff (jaxpr-pinned in
+    tests/test_serving.py, the tp_overlap pin style)."""
+    from frl_distributed_ml_scaffold_tpu.dist.mesh import (
+        BATCH_AXES,
+        current_mesh_env,
+    )
+
+    env = current_mesh_env()
+    if env is None or env.axis_size("model") <= 1:
+        return x
+    if x.shape[2] % env.axis_size("model") != 0:
+        return x
+    batch = BATCH_AXES if x.shape[0] % env.batch_axis_size == 0 else None
+    from jax.sharding import NamedSharding
+
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(env.mesh, P(batch, None, "model", None))
+    )
 
 
 class CausalSelfAttention(nn.Module):
@@ -137,10 +167,19 @@ class CausalSelfAttention(nn.Module):
     # a matmul-reduce-scatter ring instead of matmul+allreduce. Params are
     # untouched — the hooks ride nn.Dense's injectable dot_general.
     tp: Any = None
+    # Decode KV-cache capacity (0 = config.seq_len): serving buckets the
+    # cache to a power of two covering prompt+budget so short requests
+    # stop paying full-context cache traffic (serving/engine.py policy).
+    cache_len: int = 0
 
     @nn.compact
     def __call__(
-        self, x: jnp.ndarray, *, train: bool, decode: bool = False
+        self,
+        x: jnp.ndarray,
+        *,
+        train: bool,
+        decode: bool = False,
+        lengths: jnp.ndarray | None = None,
     ) -> jnp.ndarray:
         cfg = self.config
         d = cfg.hidden_dim
@@ -166,11 +205,14 @@ class CausalSelfAttention(nn.Module):
         v = v.reshape(b, t, h, hd)
 
         if decode:
-            # Incremental decoding: append this call's K/V at the absolute
-            # write position and attend over the whole cache. The flash/
-            # ring/ulysses training kernels are pointless at decode shapes
-            # (q is one token), so every attention mode shares this path.
-            s = cfg.seq_len
+            # Incremental decoding: append this call's K/V at each row's
+            # write position and attend over the occupied cache prefix.
+            # The flash/ring/ulysses training kernels are pointless at
+            # decode shapes (q is one token), so every attention mode
+            # shares this path; single-token steps route through
+            # ops/decode_attention (flash-decode kernel or its
+            # identical-numerics dense fallback, per cfg.decode_attention).
+            s = self.cache_len or cfg.seq_len
             # Cache vars are created lazily on first use: flax permits
             # variable creation during apply when the collection is mutable.
             ck = self.variable(
@@ -179,21 +221,63 @@ class CausalSelfAttention(nn.Module):
             cv = self.variable(
                 "cache", "cached_value", jnp.zeros, (b, s, h, hd), self.dtype
             )
+            # Per-ROW write index: serving slots decode at different
+            # occupancies (continuous batching), so the index is [B], the
+            # write is a batched scatter, and the mask is per-row.
             ci = self.variable(
-                "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+                "cache", "cache_index", jnp.zeros, (b,), jnp.int32
             )
-            idx = ci.value
-            ck.value = jax.lax.dynamic_update_slice(
-                ck.value, k.astype(self.dtype), (0, idx, 0, 0)
+            idx = ci.value  # [B]
+            lens = (
+                jnp.full((b,), t, jnp.int32)
+                if lengths is None
+                else lengths.astype(jnp.int32)
             )
-            cv.value = jax.lax.dynamic_update_slice(
-                cv.value, v.astype(self.dtype), (0, idx, 0, 0)
+            pad = t - lens  # [B] left-pad widths (0 when not ragged)
+            k_w, v_w = k.astype(self.dtype), v.astype(self.dtype)
+            if t > 1:
+                # Ragged prefill: prompts arrive LEFT-padded ([pad | real]
+                # columns). Roll each row so its real tokens land at cache
+                # slots [0, len) — the cache is stored densely by absolute
+                # position, which is what lets the decode kernel read only
+                # the occupied prefix. The trailing t-len written slots
+                # hold wrapped pad garbage; they sit at positions >= len,
+                # masked now and overwritten by later decode steps.
+                roll_cols = (jnp.arange(t)[None, :] + pad[:, None]) % t
+                k_w = jnp.take_along_axis(
+                    k_w, roll_cols[:, :, None, None], axis=1
+                )
+                v_w = jnp.take_along_axis(
+                    v_w, roll_cols[:, :, None, None], axis=1
+                )
+            rows = jnp.arange(b)[:, None]
+            write_cols = jnp.clip(idx[:, None] + jnp.arange(t)[None, :], 0, s - 1)
+            ck.value = _constrain_kv_cache(
+                ck.value.at[rows, write_cols].set(k_w)
             )
-            qpos = idx + jnp.arange(t)
-            kpos = jnp.arange(s)
-            mask = kpos[None, :] <= qpos[:, None]  # [t, S]; empty slots are future
-            y = _masked_dense_attention(q, ck.value, cv.value, mask)
-            ci.value = idx + t
+            cv.value = _constrain_kv_cache(
+                cv.value.at[rows, write_cols].set(v_w)
+            )
+            if t == 1:
+                from frl_distributed_ml_scaffold_tpu.ops.decode_attention import (
+                    decode_attention,
+                )
+
+                y = decode_attention(
+                    q[:, 0], ck.value, cv.value, idx + 1,
+                    impl=cfg.decode_attention,
+                )[:, None]
+            else:
+                # Query at column j has absolute position idx + j - pad
+                # (pad columns clip to 0: their outputs are never read,
+                # but the softmax must stay finite).
+                qpos = jnp.maximum(
+                    idx[:, None] + jnp.arange(t)[None, :] - pad[:, None], 0
+                )  # [B, t]
+                kpos = jnp.arange(s)
+                mask = kpos[None, None, :] <= qpos[:, :, None]  # [B, t, S]
+                y = _masked_dense_attention(q, ck.value, cv.value, mask)
+            ci.value = idx + lens
         elif cfg.attention == "ring":
             from frl_distributed_ml_scaffold_tpu.ops.ring_attention import (
                 ring_attention,
@@ -257,15 +341,22 @@ class Block(nn.Module):
     train: bool  # static per-trace; bound at GPT.__call__ construction time
     decode: bool = False  # KV-cache incremental decoding
     tp: Any = None  # collective-matmul TP hooks (parallel/tp_overlap.py)
+    cache_len: int = 0  # decode cache bucket (0 = config.seq_len)
 
     @nn.compact
     def __call__(self, carry, _unused):
-        x, aux_loss = carry
+        # Decode mode threads the per-row prompt lengths through the scan
+        # carry (a traced array cannot be a module attribute); they are
+        # loop-invariant.
+        if self.decode:
+            x, aux_loss, lengths = carry
+        else:
+            (x, aux_loss), lengths = carry, None
         cfg, train, tp = self.config, self.train, self.tp
         y = nn.LayerNorm(dtype=jnp.float32, epsilon=cfg.layer_norm_epsilon, name="ln1")(x)
-        attn_out = CausalSelfAttention(cfg, self.dtype, tp=tp, name="attn")(
-            y, train=train, decode=self.decode
-        )
+        attn_out = CausalSelfAttention(
+            cfg, self.dtype, tp=tp, cache_len=self.cache_len, name="attn"
+        )(y, train=train, decode=self.decode, lengths=lengths)
         # Named for block_remat="save_attn": saving this one [B,T,D] tensor
         # per layer lets the per-block recompute skip the attention sublayer
         # (the quadratic part). A no-op unless a checkpoint policy asks.
@@ -289,6 +380,8 @@ class Block(nn.Module):
         x = x + mlp_out
         if tp is not None:
             x = tp.constrain_stream(x)
+        if self.decode:
+            return (x, aux_loss, lengths), None
         return (x, aux_loss), None
 
 
@@ -310,6 +403,11 @@ class GPT(nn.Module):
     # stream sequence-sharded over the model axis. Attached by the Trainer
     # like param_hooks; init/decode always run unhooked.
     tp_overlap: Any = None
+    # Decode KV-cache capacity (0 = config.seq_len). generate()/the
+    # serving engine clone the model with the active bucket so the cache
+    # arrays — and everything that reads them — are sized to the request
+    # window, not the model's maximum context.
+    cache_len: int = 0
 
     @nn.compact
     def __call__(
@@ -319,10 +417,16 @@ class GPT(nn.Module):
         train: bool = False,
         decode: bool = False,
         return_features: bool = False,
+        lengths: jnp.ndarray | None = None,
     ):
         cfg = self.config
         dtype = self.policy.compute_dtype
         b, t = tokens.shape
+        if lengths is not None and not decode:
+            raise ValueError(
+                "lengths (ragged left-padded prompts) is a decode-mode "
+                "argument; training/eval batches are dense"
+            )
 
         wte = nn.Embed(
             cfg.vocab_size,
@@ -335,15 +439,32 @@ class GPT(nn.Module):
             "wpe", nn.initializers.normal(stddev=0.02), (cfg.seq_len, cfg.hidden_dim)
         )
         if decode:
-            # Positions are absolute: offset by how much of the cache this
-            # call's tokens come after (tracked here so the embedding and
-            # the per-layer attention caches advance together).
+            # Positions are absolute and PER ROW: offset by how much of
+            # each row's cache this call's tokens come after (tracked here
+            # so the embedding and the per-layer attention caches advance
+            # together; rows diverge under ragged prompts and continuous
+            # batching). Left-pad columns clip to position 0 — their
+            # embeddings feed garbage lanes that the attention mask and
+            # the right-aligned logit read both ignore.
             pos = self.variable(
-                "cache", "pos_index", lambda: jnp.zeros((), jnp.int32)
+                "cache", "pos_index", jnp.zeros, (b,), jnp.int32
             )
-            offset = pos.value
-            pe = jax.lax.dynamic_slice(wpe, (offset, 0), (t, cfg.hidden_dim))
-            pos.value = offset + t
+            # Canonical per-row lengths, computed ONCE for the whole
+            # decode trace: the position offsets here and the cache
+            # writes/masks in every scanned block (via the scan carry)
+            # must advance from the same array.
+            lens = (
+                jnp.full((b,), t, jnp.int32)
+                if lengths is None
+                else lengths.astype(jnp.int32)
+            )
+            pos_ids = jnp.clip(
+                pos.value[:, None] + jnp.arange(t)[None, :] - (t - lens)[:, None],
+                0,
+                cfg.seq_len - 1,
+            )  # [B, t]
+            pe = jnp.take(wpe, pos_ids, axis=0)  # [B, t, D]
+            pos.value = pos.value + lens
         else:
             pe = wpe[:t]
         x = wte(tokens) + pe.astype(dtype)
@@ -438,9 +559,18 @@ class GPT(nn.Module):
                 train,
                 decode,
                 None if decode else self.tp_overlap,
+                self.cache_len if decode else 0,
                 name="blocks",
             )
-            (x, aux_loss), _ = blocks((x, jnp.zeros((), jnp.float32)), None)
+            if decode:
+                # `lens` from the position block above — one defaulting
+                # site for the whole decode trace.
+                carry0 = (x, jnp.zeros((), jnp.float32), lens)
+                (x, aux_loss, _), _ = blocks(carry0, None)
+            else:
+                (x, aux_loss), _ = blocks(
+                    (x, jnp.zeros((), jnp.float32)), None
+                )
 
         x = nn.LayerNorm(dtype=jnp.float32, epsilon=cfg.layer_norm_epsilon, name="ln_f")(x)
         if return_features:

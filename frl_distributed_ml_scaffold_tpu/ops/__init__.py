@@ -16,6 +16,10 @@ sharding annotations alone:
   sequence-sharded mesh it delegates to ``ring_attention``.
 - ``dense_attention`` — the single-device reference all sharded paths
   reduce to; fp32 softmax, bf16-multiply/fp32-accumulate einsums.
+- ``decode_attention`` — the serving-side fused split-KV single-token
+  decode kernel over the KV cache (length-masked to the occupied prefix,
+  head-sharded over the ``model`` axis under a mesh) with
+  ``dense_decode_attention`` as its identical-numerics reference.
 
 All are drop-in (B, T, H, D)-shaped attention functions used by the GPT
 model's ``attention=`` config switch.
@@ -31,3 +35,7 @@ from frl_distributed_ml_scaffold_tpu.ops.ring_attention import (
     ring_attention,
 )
 from frl_distributed_ml_scaffold_tpu.ops.ulysses import ulysses_attention
+from frl_distributed_ml_scaffold_tpu.ops.decode_attention import (
+    decode_attention,
+    dense_decode_attention,
+)

@@ -1,0 +1,266 @@
+"""Pallas TPU flash-decode attention: fused split-KV single-token decode.
+
+The serving-side sibling of ``ops/flash_attention.py``. Training attention
+streams K/V blocks under a [T, T] score tile; at decode the query is ONE
+token per sequence, so the kernel shape flips: scores are a [H, S] strip
+and the win is (i) never materializing the [B, H, S] probability tensor in
+HBM and (ii) never *reading* cache rows past the occupied prefix. The
+kernel is a split-KV partial-softmax: the cache length S is tiled into
+``block_k`` chunks walked by the inner grid dimension (TPU grids iterate
+sequentially, so the running max / denominator / accumulator live in VMEM
+scratch and the chunk merge is the standard online-softmax log-sum-exp
+rescale — numerically the same merge the flash kernel and the ring hops
+use).
+
+Length masking is first-class, not an afterthought: the per-row occupancy
+``kv_len`` rides the scalar-prefetch channel (``PrefetchScalarGridSpec``),
+so it is available to the *index maps* — chunks entirely past a row's
+occupancy clamp their DMA to the last live chunk and skip their compute via
+``pl.when``. A bucketed cache (serving/engine.py) bounds the worst case;
+the length clamp means a request at occupancy 70 in a 512-bucket reads ~70
+rows of cache, not 512 and not ``config.seq_len``.
+
+Decode is inference-only, so there is no VJP — the kernel is forward-only,
+which also keeps the router trivially compatible with ``lax.scan`` decode
+loops.
+
+Layout: public API is cache layout — q ``[B, H, D]`` (the single token's
+heads), k/v ``[B, S, H, D]`` (exactly how models/gpt.py stores the cache),
+``kv_len [B]`` int32. The kernel internally runs ``[B, H, S, D]`` like its
+training sibling.
+
+On non-TPU backends the kernel runs under the Pallas interpreter when
+``interpret=True`` is forced (tests); the default off-TPU path is the
+identical-numerics ``dense_decode_attention`` — the same silent-fallback
+contract as ``flash_attention`` / ``fused_bn``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+from frl_distributed_ml_scaffold_tpu.ops.flash_attention import (
+    _pick_block,
+    _warn_fallback,
+)
+
+_NEG_INF = -1.0e30
+
+#: Test hook (the ``fused_bn.FORCE_INTERPRET`` pattern): set to True to
+#: force the Pallas interpreter through model-level entry points that do
+#: not expose an ``interpret`` argument.
+FORCE_INTERPRET: bool | None = None
+
+
+def dense_decode_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, kv_len: jax.Array
+) -> jax.Array:
+    """Reference decode attention: q ``[B, H, D]`` against the cache
+    ``[B, S, H, D]``, keys at positions >= ``kv_len[b]`` masked out. fp32
+    softmax, bf16-multiply/fp32-accumulate — the numerics contract the
+    kernel is gated against (and the same contract as
+    ``_masked_dense_attention`` in models/gpt.py)."""
+    d = q.shape[-1]
+    s = jnp.einsum("bhd,bshd->bhs", q, k, preferred_element_type=jnp.float32)
+    s = s / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    kpos = jnp.arange(k.shape[1])
+    mask = kpos[None, :] < kv_len[:, None]  # [B, S]
+    s = jnp.where(mask[:, None, :], s, jnp.finfo(jnp.float32).min)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum(
+        "bhs,bshd->bhd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return o.astype(q.dtype)
+
+
+# ------------------------------------------------------------------ kernel
+
+
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
+                   acc_ref, *, block_k, scale):
+    """One (batch row, KV chunk) program: all H heads at once, so the
+    sublane dimension of every tile is H (scores are [H, block_k])."""
+    b_, j = pl.program_id(0), pl.program_id(1)
+    n_k = pl.num_programs(1)
+    length = len_ref[b_]
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # Chunks entirely past this row's occupancy contribute nothing (their
+    # DMA is clamped to the last live chunk by the index map below).
+    @pl.when(j * block_k < length)
+    def _step():
+        q = q_ref[0, :, 0, :]  # (H, D)
+        k_blk = k_ref[0]  # (H, Bk, D)
+        v_blk = v_ref[0]
+        # Batched-over-heads matvec on the MXU: (H, D) x (H, Bk, D) -> (H, Bk).
+        s = lax.dot_general(
+            q, k_blk,
+            dimension_numbers=(((1,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        kpos = j * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(kpos < length, s, _NEG_INF)
+        m = m_ref[:]
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        m_ref[:] = m_new
+        l_ref[:] = l_ref[:] * alpha + p.sum(axis=-1, keepdims=True)
+        # (H, Bk) x (H, Bk, D) -> (H, D), batched over H.
+        acc_ref[:] = acc_ref[:] * alpha + lax.dot_general(
+            p.astype(v_blk.dtype), v_blk,
+            dimension_numbers=(((1,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == n_k - 1)
+    def _finish():
+        l_safe = jnp.maximum(l_ref[:], 1e-30)
+        o_ref[0, :, 0, :] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+
+
+def _kv_index_map(block_k):
+    """Clamp the chunk index to the row's last OCCUPIED chunk: programs
+    past the occupancy re-reference the chunk already resident, so no DMA
+    fires for dead cache rows (their compute is skipped by ``pl.when``).
+    The scalar-prefetch channel is what makes the length visible here,
+    before the kernel body runs."""
+
+    def index_map(b_, j, len_ref):
+        last = jnp.maximum((len_ref[b_] - 1) // block_k, 0)
+        return (b_, 0, jnp.minimum(j, last), 0)
+
+    return index_map
+
+
+def _flash_decode(q, k, v, kv_len, *, block_k, interpret):
+    """q ``[B, H, 1, D]``, k/v ``[B, H, S, D]`` (kernel layout), kv_len
+    ``[B]`` int32 -> ``[B, H, 1, D]``."""
+    b, h, s, d = k.shape
+    n_k = s // block_k
+    q_spec = pl.BlockSpec((1, h, 1, d), lambda b_, j, len_ref: (b_, 0, 0, 0))
+    kv_spec = pl.BlockSpec((1, h, block_k, d), _kv_index_map(block_k))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, n_k),
+        in_specs=[q_spec, kv_spec, kv_spec],
+        out_specs=q_spec,
+        scratch_shapes=[
+            pltpu.VMEM((h, 1), jnp.float32),  # running max
+            pltpu.VMEM((h, 1), jnp.float32),  # running denom
+            pltpu.VMEM((h, d), jnp.float32),  # output accumulator
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(
+            _decode_kernel, block_k=block_k, scale=1.0 / np.sqrt(d)
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(kv_len, q, k, v)
+
+
+# ------------------------------------------------------------------ router
+
+
+#: Preferred KV chunk: decode is HBM-bandwidth-bound, so the chunk only has
+#: to be big enough to amortize the revolving-buffer DMA; 512 matches the
+#: short-T training block. The on-chip ladder is queued (BACKLOG R8-1).
+_PREFERRED_BLOCK_K = 512
+
+
+def _local_decode(q, k, v, kv_len, *, impl, interpret):
+    """Decode attention on LOCAL (already per-shard) arrays."""
+    if impl == "dense":
+        return dense_decode_attention(q, k, v, kv_len)
+    if impl != "flash":
+        raise KeyError(
+            f"unknown decode_attention impl {impl!r} (dense | flash)"
+        )
+    if interpret is None:
+        interpret = FORCE_INTERPRET
+    s, d = k.shape[1], q.shape[-1]
+    block_k = _pick_block(s, min(_PREFERRED_BLOCK_K, s))
+    if block_k is None or d % 32 != 0:
+        if jax.default_backend() == "tpu":
+            _warn_fallback(
+                "flash-decode falling back to dense: cache shape "
+                f"(S={s}, head_dim={d}) is not tileable (need a "
+                "power-of-two divisor of S and head_dim % 32 == 0)"
+            )
+        return dense_decode_attention(q, k, v, kv_len)
+    if interpret is None:
+        if jax.default_backend() != "tpu":
+            # Identical numerics, no interpreter slowdown — the same
+            # silent off-TPU contract as flash_attention.
+            return dense_decode_attention(q, k, v, kv_len)
+        interpret = False
+    qT = q[:, :, None, :]  # [B, H, 1, D]
+    kT = k.transpose(0, 2, 1, 3)  # [B, H, S, D]
+    vT = v.transpose(0, 2, 1, 3)
+    lens = jnp.maximum(kv_len.astype(jnp.int32), 1)
+    o = _flash_decode(qT, kT, vT, lens, block_k=block_k, interpret=interpret)
+    return o[:, :, 0, :]
+
+
+def decode_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    kv_len: jax.Array,
+    *,
+    impl: str = "flash",
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Single-token decode attention over a KV cache — the ONE entry point
+    every decode consumer (generate, beam_search, serving/engine.py) routes
+    through.
+
+    q ``[B, H, D]``, k/v ``[B, S, H, D]`` (cache layout), ``kv_len [B]``
+    int32 occupancy per row. Under a mesh whose ``model`` axis is live the
+    call runs head-sharded via shard_map (GSPMD cannot partition an opaque
+    pallas_call, and even the dense path benefits from a pinned layout):
+    each shard attends its local heads against its local cache shard —
+    zero collectives here; the one psum per block happens where Megatron
+    puts it, in the row-sharded ``out`` projection that consumes this
+    output. The batch dimension shards over the batch axes exactly when
+    the cache constraint does (``_constrain_kv_cache``): the two MUST
+    agree, or entering this region would all-gather the cache's batch
+    shards — the monolithic reshard the handoff pin forbids.
+    """
+    from frl_distributed_ml_scaffold_tpu.dist.mesh import (
+        BATCH_AXES,
+        current_mesh_env,
+        shard_map_compat,
+    )
+
+    env = current_mesh_env()
+    m = env.axis_size("model") if env is not None else 1
+    h = q.shape[1]
+    if env is None or m <= 1 or h % m != 0:
+        return _local_decode(q, k, v, kv_len, impl=impl, interpret=interpret)
+    batch = BATCH_AXES if q.shape[0] % env.batch_axis_size == 0 else None
+    q_spec = P(batch, "model", None)
+    kv_spec = P(batch, None, "model", None)
+    fn = shard_map_compat(
+        functools.partial(_local_decode, impl=impl, interpret=interpret),
+        mesh=env.mesh,
+        in_specs=(q_spec, kv_spec, kv_spec, P(batch)),
+        out_specs=q_spec,
+    )
+    return fn(q, k, v, kv_len)

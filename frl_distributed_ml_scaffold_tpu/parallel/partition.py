@@ -180,3 +180,22 @@ def shardings_from_specs(specs: Any, mesh: Mesh) -> Any:
         specs,
         is_leaf=lambda x: isinstance(x, P),
     )
+
+
+def shard_params_for_serving(params: Any, env: Any, rules: PartitionRules) -> Any:
+    """Place an (unsharded) params tree onto a serving mesh per the model's
+    TP rules — the one-call version of derive-specs + device_put that
+    every decode consumer (serving/engine.py callers, tools/serve_bench.py,
+    the sharded-decode tests) otherwise hand-rolls.
+
+    Serving has no optimizer state and no FSDP overlay — params are
+    either replicated or Megatron-sharded over ``model`` — so the overlay
+    config is the default ``ParallelConfig()`` (replicated base) and only
+    ``rules`` decides placement. The head-sharded KV cache then follows
+    from these kernels at trace time (models/gpt.py pins the layout)."""
+    specs = param_specs(params, ParallelConfig(), env.mesh, rules)
+    return jax.tree.map(
+        lambda p, s: jax.device_put(p, NamedSharding(env.mesh, s)),
+        params,
+        specs,
+    )

@@ -1,0 +1,17 @@
+"""Decode-optimized serving subsystem (the inference counterpart of the
+training-side overlap schedules).
+
+Three layers: the fused split-KV decode kernel (ops/decode_attention.py),
+the model-sharded KV cache the GPT decode path emits under a live
+``model`` mesh axis (models/gpt.py), and the host-side continuous-batching
+engine here — a fixed slot array with per-slot length tracking, eos
+retirement, and power-of-two cache buckets (serving/engine.py).
+"""
+
+from frl_distributed_ml_scaffold_tpu.serving.engine import (
+    Completion,
+    ServeRequest,
+    ServingEngine,
+)
+
+__all__ = ["Completion", "ServeRequest", "ServingEngine"]

@@ -1,0 +1,411 @@
+"""Host-side continuous-batching decode engine over a fixed slot array.
+
+TPU serving wants the same static-shape discipline as TPU training: every
+device program the engine runs is one of a SMALL closed set of compiled
+shapes — a prefill per prompt bucket, a decode step per cache bucket, a
+cache graft per (prompt bucket, cache bucket) pair — all powers of two up
+to ``config.seq_len`` (``models/generation.next_cache_bucket``). Requests
+of any length mix freely inside those shapes:
+
+- **Slots**: the decode batch is a fixed ``[num_slots]`` row array. Each
+  row is an independent request; per-row cache indices/positions
+  (models/gpt.py decode path) mean rows at different occupancies decode
+  together in one program.
+- **Continuous batching**: when a row emits eos (or exhausts its budget)
+  it RETIRES — the completion is returned and the slot is freed — and the
+  next queued request is prefilled into the freed row while the other
+  rows keep decoding. Admission never stalls the running rows: a prompt
+  is prefilled as a [1, prompt_bucket] program and its cache rows are
+  grafted into the engine cache at the slot index (a dynamic-update-slice,
+  not a reshard).
+- **Cache buckets**: the engine cache starts at the smallest bucket that
+  covers the live requests and GROWS bucket-by-bucket (a pad along the
+  cache axis) only when an active slot actually needs the room. Short
+  requests therefore never pay full-context cache traffic — and the
+  decode kernel additionally reads only each row's occupied prefix within
+  the bucket.
+
+Everything here is host logic around jitted pure functions; under a live
+mesh (captured at construction) the same loop serves model-sharded caches
+— the jitted programs trace under ``mesh_context`` so the decode
+attention runs head-sharded (ops/decode_attention.py router).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from frl_distributed_ml_scaffold_tpu.models.generation import (
+    _decode_step,
+    _plain_stack,
+    _prefill,
+    _sample,
+    cache_batch_axis,
+    next_cache_bucket,
+)
+
+
+@dataclasses.dataclass
+class ServeRequest:
+    """One queued generation request (prompt is an unpadded 1-D int array)."""
+
+    id: int
+    prompt: np.ndarray
+    max_new_tokens: int
+
+
+@dataclasses.dataclass
+class Completion:
+    """A finished request: prompt + generated tokens and per-token wall
+    latencies (the decode steps this request was live for)."""
+
+    id: int
+    tokens: np.ndarray  # [prompt_len + n_generated]
+    prompt_len: int
+    finish_reason: str  # "eos" | "length"
+    token_latencies_s: list[float]
+
+
+class ServingEngine:
+    """Continuous-batching engine; see the module docstring.
+
+    Usage::
+
+        eng = ServingEngine(model, params, num_slots=4, eos_id=50256)
+        eng.submit([5, 3, 8], max_new_tokens=32)
+        done = eng.run()          # or step() for one decode iteration
+    """
+
+    def __init__(
+        self,
+        model: Any,
+        params: Any,
+        *,
+        num_slots: int = 4,
+        eos_id: int | None = None,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 0.0,
+        rng: jax.Array | None = None,
+        min_bucket: int = 8,
+    ):
+        model, params = _plain_stack(model, params)
+        self.model, self.params = model, params
+        if num_slots < 1:
+            raise ValueError(
+                f"num_slots={num_slots} < 1: zero slots can never admit, "
+                "so run() would spin on a non-empty queue forever"
+            )
+        self.num_slots = int(num_slots)
+        self.eos_id = eos_id
+        self._sample_kw = dict(
+            temperature=temperature, top_k=top_k, top_p=top_p
+        )
+        self._rng = jax.random.key(0) if rng is None else rng
+        self.min_bucket = int(min_bucket)
+        self.seq_len = model.config.seq_len
+
+        # The mesh is captured ONCE: every jitted program traces under it,
+        # so replicated and sharded engines never share a trace.
+        from frl_distributed_ml_scaffold_tpu.dist.mesh import current_mesh_env
+
+        self._env = current_mesh_env()
+
+        self._queue: collections.deque[ServeRequest] = collections.deque()
+        self._next_id = 0
+        self._issued_ids: set[int] = set()
+        # Host-side slot state.
+        self._req: list[ServeRequest | None] = [None] * self.num_slots
+        self._tokens: list[list[int]] = [[] for _ in range(self.num_slots)]
+        self._len = np.zeros(self.num_slots, np.int64)  # prompt+generated
+        self._active = np.zeros(self.num_slots, bool)
+        self._latency: list[list[float]] = [[] for _ in range(self.num_slots)]
+        self._last_tok = np.zeros(self.num_slots, np.int32)
+
+        self.cache: Any = None
+        self.bucket = 0
+        # Jit caches keyed on the static shapes they close over.
+        self._prefill_jit: dict[int, Any] = {}
+        self._decode_jit: dict[int, Any] = {}
+        self._graft_jit: dict[tuple[int, int], Any] = {}
+        self._grow_jit: dict[tuple[int, int], Any] = {}
+        # Observability: how often each compiled-shape class actually ran.
+        self.stats = collections.Counter()
+
+    # ----------------------------------------------------------- frontend
+
+    def submit(
+        self, prompt, max_new_tokens: int, request_id: int | None = None
+    ) -> int:
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens={max_new_tokens} < 1: prefill always "
+                "samples the first token, so a request must want at least "
+                "one (this also keeps prompt_len + 1 within the cache)"
+            )
+        if prompt.size + max_new_tokens > self.seq_len:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds the model context ({self.seq_len})"
+            )
+        rid = self._next_id if request_id is None else request_id
+        if rid in self._issued_ids:
+            raise ValueError(
+                f"request_id {rid} already used — completions are keyed "
+                "by id, so a duplicate would silently shadow a result"
+            )
+        self._issued_ids.add(rid)
+        self._next_id = max(self._next_id, rid) + 1
+        self._queue.append(ServeRequest(rid, prompt, int(max_new_tokens)))
+        return rid
+
+    @property
+    def pending(self) -> int:
+        return len(self._queue) + int(self._active.sum())
+
+    def reset_cache(self) -> None:
+        """Drop the device cache and bucket state (jit caches survive —
+        they are keyed on shapes, not state). For measurement loops that
+        want a cold-state pass over warm compiled programs
+        (tools/serve_bench.py): the bucket trajectory replays instead of
+        starting at the warm pass's terminal bucket. Refuses while
+        requests are in flight."""
+        if self._active.any():
+            raise RuntimeError("reset_cache with active slots in flight")
+        self.cache = None
+        self.bucket = 0
+        self.stats.clear()
+
+    def run(self, max_steps: int | None = None) -> list[Completion]:
+        """Drain the queue; returns completions in finish order."""
+        out: list[Completion] = []
+        steps = 0
+        while self.pending:
+            out.extend(self.step())
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+        return out
+
+    # ------------------------------------------------------ jitted shapes
+
+    def _model_at(self, cache_len: int):
+        return self.model.clone(cache_len=int(cache_len))
+
+    def _trace_ctx(self):
+        from frl_distributed_ml_scaffold_tpu.dist.mesh import mesh_context
+
+        return mesh_context(self._env)
+
+    def _prefill_fn(self, s_p: int):
+        if s_p not in self._prefill_jit:
+            m = self._model_at(s_p)
+            kw = dict(self._sample_kw)
+
+            def fn(params, prompt, lengths, rng):
+                logits, cache = _prefill(m, params, prompt, lengths)
+                return _sample(logits, rng, **kw), cache
+
+            self._prefill_jit[s_p] = jax.jit(fn)
+        return self._prefill_jit[s_p]
+
+    def _decode_fn(self, s: int):
+        if s not in self._decode_jit:
+            m = self._model_at(s)
+            kw = dict(self._sample_kw)
+
+            def fn(params, cache, tok, rng):
+                logits, cache = _decode_step(m, params, cache, tok)
+                return _sample(logits, rng, **kw), cache
+
+            self._decode_jit[s] = jax.jit(fn)
+        return self._decode_jit[s]
+
+    def _graft_fn(self, s_p: int, s: int):
+        """Write one prefilled request's cache rows into the engine cache
+        at a (traced) slot index: a dynamic-update-slice at the leaf's
+        slot-row axis (``generation.cache_batch_axis`` — THE cache-leaf
+        taxonomy; the beam gather/repeat route through the same
+        classifier, so new leaf classes stay in lockstep)."""
+        if (s_p, s) not in self._graft_jit:
+            n = self.num_slots
+
+            def fn(cache, slot_cache, slot):
+                def leaf(e, p):
+                    ax = cache_batch_axis(e, n)
+                    assert ax is not None, (
+                        f"cache leaf {e.shape} carries no slot rows"
+                    )
+                    idx = (0,) * ax + (slot,) + (0,) * (e.ndim - ax - 1)
+                    return jax.lax.dynamic_update_slice(
+                        e, p.astype(e.dtype), idx
+                    )
+
+                return jax.tree.map(leaf, cache, slot_cache)
+
+            self._graft_jit[(s_p, s)] = jax.jit(fn)
+        return self._graft_jit[(s_p, s)]
+
+    def _grow_fn(self, s_old: int, s_new: int):
+        if (s_old, s_new) not in self._grow_jit:
+
+            def fn(cache):
+                def leaf(e):
+                    if e.ndim == 5:  # pad the cache axis
+                        pad = [(0, 0)] * 5
+                        pad[2] = (0, s_new - s_old)
+                        return jnp.pad(e, pad)
+                    return e
+
+                return jax.tree.map(leaf, cache)
+
+            self._grow_jit[(s_old, s_new)] = jax.jit(fn)
+        return self._grow_jit[(s_old, s_new)]
+
+    # --------------------------------------------------------- scheduling
+
+    def _bucket_for(self, needed: int) -> int:
+        return next_cache_bucket(self.seq_len, needed, floor=self.min_bucket)
+
+    def _empty_cache(self, slot_cache, s: int):
+        """Zeros shaped like a 1-request slot cache widened to the slot
+        array (row axis per ``cache_batch_axis``) at cache capacity ``s``
+        (the K/V stacks' cache axis 2 — the one leaf class with a
+        capacity dim, same special case as ``_grow_fn``)."""
+        n = self.num_slots
+
+        def leaf(e):
+            ax = cache_batch_axis(e, 1)  # slot cache has batch 1
+            assert ax is not None, f"cache leaf {e.shape} carries no rows"
+            shape = list(e.shape)
+            shape[ax] = n
+            if e.ndim == 5:
+                shape[2] = s
+            return jnp.zeros(tuple(shape), e.dtype)
+
+        return jax.tree.map(leaf, slot_cache)
+
+    def _ensure_bucket(self, needed: int) -> None:
+        target = self._bucket_for(needed)
+        if target > self.bucket:
+            self.cache = self._grow_fn(self.bucket, target)(self.cache)
+            self.stats[f"grow_{self.bucket}->{target}"] += 1
+            self.bucket = target
+
+    def _admit(self) -> None:
+        for slot in range(self.num_slots):
+            if self._active[slot] or not self._queue:
+                continue
+            req = self._queue.popleft()
+            l = int(req.prompt.size)
+            s_p = self._bucket_for(l)
+            prompt = np.zeros((1, s_p), np.int32)
+            prompt[0, s_p - l :] = req.prompt  # left-pad, right-aligned
+            self._rng, sub = jax.random.split(self._rng)
+            t0 = time.perf_counter()
+            with self._trace_ctx():
+                tok, slot_cache = self._prefill_fn(s_p)(
+                    self.params,
+                    jnp.asarray(prompt),
+                    jnp.asarray([l], jnp.int32),
+                    sub,
+                )
+                if self.cache is None:
+                    self.cache = self._empty_cache(slot_cache, s_p)
+                    self.bucket = s_p
+                self._ensure_bucket(max(s_p, l + 1))
+                self.cache = self._graft_fn(s_p, self.bucket)(
+                    self.cache, slot_cache, jnp.int32(slot)
+                )
+            tok = int(jax.device_get(tok)[0])
+            dt = time.perf_counter() - t0
+            self.stats[f"prefill_{s_p}"] += 1
+
+            self._req[slot] = req
+            self._tokens[slot] = [tok]
+            self._len[slot] = l + 1
+            self._active[slot] = True
+            self._latency[slot] = [dt]
+            self._last_tok[slot] = tok
+            # The first sampled token can already finish the request.
+            if self._finishes(slot, tok):
+                continue
+
+    def _finishes(self, slot: int, tok: int) -> bool:
+        req = self._req[slot]
+        if self.eos_id is not None and tok == self.eos_id:
+            self._retire(slot, "eos")
+            return True
+        if len(self._tokens[slot]) >= req.max_new_tokens:
+            self._retire(slot, "length")
+            return True
+        return False
+
+    def _retire(self, slot: int, reason: str) -> None:
+        req = self._req[slot]
+        comp = Completion(
+            id=req.id,
+            tokens=np.concatenate(
+                [req.prompt, np.asarray(self._tokens[slot], np.int32)]
+            ),
+            prompt_len=int(req.prompt.size),
+            finish_reason=reason,
+            token_latencies_s=self._latency[slot],
+        )
+        self._completed.append(comp)
+        self._req[slot] = None
+        self._active[slot] = False
+        self.stats["completed"] += 1
+        self.stats[f"finish_{reason}"] += 1
+
+    # --------------------------------------------------------------- step
+
+    def step(self) -> list[Completion]:
+        """Admit into free slots, run ONE decode iteration over the slot
+        array, retire finished rows. Returns requests completed during
+        this step (possibly at admission, for 1-token budgets)."""
+        self._completed: list[Completion] = []
+        self._admit()
+        if not self._active.any():
+            return self._completed
+
+        # Bucket must hold every active row's next write position: an
+        # active row holds cache_index == _len - 1 (prefill sets idx=l
+        # with _len=l+1; both advance together), so this step writes
+        # position _len - 1 and needs capacity exactly _len.
+        self._ensure_bucket(int(self._len[self._active].max()))
+
+        self._rng, sub = jax.random.split(self._rng)
+        t0 = time.perf_counter()
+        with self._trace_ctx():
+            nxt, self.cache = self._decode_fn(self.bucket)(
+                self.params,
+                self.cache,
+                jnp.asarray(self._last_tok),
+                sub,
+            )
+        nxt = np.asarray(jax.device_get(nxt))
+        dt = time.perf_counter() - t0
+        self.stats[f"decode_{self.bucket}"] += 1
+        self.stats["decode_steps"] += 1
+
+        for slot in range(self.num_slots):
+            if not self._active[slot]:
+                continue
+            tok = int(nxt[slot])
+            self._tokens[slot].append(tok)
+            self._len[slot] += 1
+            self._latency[slot].append(dt)
+            self._last_tok[slot] = tok
+            self._finishes(slot, tok)
+        return self._completed

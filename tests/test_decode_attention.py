@@ -1,0 +1,275 @@
+"""Flash-decode kernel gates (ops/decode_attention.py).
+
+The same contract every kernel in the repo is held to (fused_bn, flash
+attention): interpreter-mode equivalence against the identical-numerics
+dense reference — here across cache OCCUPANCY (the dimension the split-KV
+kernel is built around: occupancy 1, chunk boundaries, full bucket) and
+dtypes — plus the decode-path integration gates: the model's decode step
+must read only the active cache bucket (jaxpr-pinned), and the
+flash-routed model must reproduce the dense decode path token-for-token.
+"""
+
+from __future__ import annotations
+
+import pytest as _pytest_mark
+
+# Whole module is `serving`; the op-level kernel gates (sub-second,
+# interpreter-mode) additionally ride `fast` per-test — the model-level
+# integration gates compile multi-second decode programs and stay tier-1.
+pytestmark = _pytest_mark.mark.serving
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from _jit import jit_init
+
+from frl_distributed_ml_scaffold_tpu.config.schema import (
+    GPTConfig,
+    PrecisionConfig,
+)
+from frl_distributed_ml_scaffold_tpu.models.gpt import GPT
+# The submodule via importlib: the ops package re-exports the
+# decode_attention FUNCTION, which shadows the submodule attribute on
+# every `import ... as` form (the flash_attention naming pattern).
+import importlib
+
+da = importlib.import_module(
+    "frl_distributed_ml_scaffold_tpu.ops.decode_attention"
+)
+from frl_distributed_ml_scaffold_tpu.precision import get_policy
+
+FP32 = get_policy(PrecisionConfig(policy="fp32"))
+
+
+def _make(b, s, h, d, dtype, seed=0):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.normal(size=(b, h, d)), dtype)
+    k = jnp.asarray(rng.normal(size=(b, s, h, d)), dtype)
+    v = jnp.asarray(rng.normal(size=(b, s, h, d)), dtype)
+    return q, k, v
+
+
+#: Occupancy classes per bucket S: a single row (the first decode step of
+#: a fresh request), straddling the first KV-chunk boundary, a mid-bucket
+#: interior point, and the full bucket — plus per-ROW variation inside
+#: each case (the engine's slots never share an occupancy).
+def _occupancies(s):
+    return sorted({1, 2, min(8, s), min(9, s), s // 2, s - 1, s})
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16],
+                         ids=["fp32", "bf16"])
+@pytest.mark.fast
+@pytest.mark.parametrize("s", [8, 64, 512], ids=lambda s: f"S{s}")
+def test_flash_decode_matches_dense_across_occupancies(dtype, s):
+    """Interpreter-mode kernel == dense reference at every occupancy
+    class of every bucket size, fp32 to fp32 tolerance and bf16 to one-ulp
+    class tolerance (the repo's standard kernel gate)."""
+    b, h, d = 3, 4, 64
+    for occ in _occupancies(s):
+        q, k, v = _make(b, s, h, d, dtype, seed=occ)
+        lens = jnp.asarray(
+            [occ, max(1, occ // 2), min(s, occ + 3)], jnp.int32
+        )
+        ref = da.dense_decode_attention(q, k, v, lens)
+        out = da._local_decode(q, k, v, lens, impl="flash", interpret=True)
+        ref32 = np.asarray(ref, np.float32)
+        out32 = np.asarray(out, np.float32)
+        if dtype == jnp.float32:
+            np.testing.assert_allclose(ref32, out32, atol=2e-6, rtol=2e-6)
+        else:
+            atol = 2 * float(jnp.finfo(jnp.bfloat16).eps) * max(
+                1.0, float(np.abs(ref32).max())
+            )
+            np.testing.assert_allclose(ref32, out32, atol=atol, rtol=0.05)
+
+
+@pytest.mark.fast
+def test_flash_decode_occupied_prefix_only():
+    """Length masking is real: cache rows at positions >= kv_len must not
+    influence the output (fill them with garbage and compare against a
+    clean cache)."""
+    b, s, h, d = 2, 64, 4, 64
+    q, k, v = _make(b, s, h, d, jnp.float32)
+    lens = jnp.asarray([5, 23], jnp.int32)
+    occ = np.arange(s)[None, :, None, None] < np.asarray(lens)[:, None, None, None]
+    k_dirty = jnp.where(occ, k, 1e6)
+    v_dirty = jnp.where(occ, v, -1e6)
+    clean = da._local_decode(q, k, v, lens, impl="flash", interpret=True)
+    dirty = da._local_decode(
+        q, k_dirty, v_dirty, lens, impl="flash", interpret=True
+    )
+    np.testing.assert_array_equal(np.asarray(clean), np.asarray(dirty))
+
+
+@pytest.mark.fast
+def test_flash_decode_untileable_falls_back_to_dense():
+    """Shapes outside the kernel contract (head_dim not sublane-aligned,
+    S with no power-of-two divisor) must take the identical-numerics dense
+    path, not miscompute."""
+    b, h = 2, 2
+    for s, d in ((48, 16), (7, 64)):
+        q, k, v = _make(b, s, h, d, jnp.float32)
+        lens = jnp.asarray([3, s], jnp.int32)
+        out = da._local_decode(q, k, v, lens, impl="flash", interpret=True)
+        ref = da.dense_decode_attention(q, k, v, lens)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+@pytest.mark.fast
+def test_decode_attention_rejects_unknown_impl():
+    q, k, v = _make(2, 8, 2, 32, jnp.float32)
+    with pytest.raises(KeyError, match="decode_attention"):
+        da._local_decode(
+            q, k, v, jnp.asarray([1, 2], jnp.int32), impl="bogus",
+            interpret=True,
+        )
+
+
+# --------------------------------------------------------- model decode
+
+
+TINY = dict(
+    vocab_size=64, num_layers=2, num_heads=2, hidden_dim=64, seq_len=96,
+    dropout=0.0,
+)
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    model = GPT(GPTConfig(**TINY), FP32)
+    tokens = jax.random.randint(jax.random.key(1), (2, 8), 0, 64)
+    params = jit_init(model, tokens, train=False)["params"]
+    return model, params, tokens
+
+
+@pytest.mark.parametrize("policy", ["fp32", "bf16_mixed"])
+def test_model_flash_decode_matches_dense_decode(policy):
+    """The integration gate, across a bucket boundary (prompt in bucket
+    16, generation crossing into 32): under fp32, generate() with
+    decode_attention=flash (kernel forced through the interpreter) must
+    reproduce the dense decode path's greedy tokens at every step. Under
+    bf16 the online-softmax merge legitimately rounds once where the
+    dense softmax rounds per op, so the gate is per-step LOGITS within
+    the bf16 ulp class on the teacher-forced dense trajectory (greedy
+    argmax on a random tiny model sits on bf16-scale ties)."""
+    import dataclasses
+
+    pol = get_policy(PrecisionConfig(policy=policy))
+    cfg = GPTConfig(**TINY)
+    tokens = jax.random.randint(jax.random.key(3), (2, 10), 0, 64)
+    model_d = GPT(dataclasses.replace(cfg, decode_attention="dense"), pol)
+    params = jit_init(model_d, tokens, train=False)["params"]
+    from frl_distributed_ml_scaffold_tpu.models.generation import generate
+
+    ref = generate(model_d, params, tokens, max_new_tokens=12,
+                   temperature=0.0)
+    model_f = GPT(dataclasses.replace(cfg, decode_attention="flash"), pol)
+    da.FORCE_INTERPRET = True
+    try:
+        if policy == "fp32":
+            out = generate(model_f, params, tokens, max_new_tokens=12,
+                           temperature=0.0)
+            np.testing.assert_array_equal(np.asarray(ref), np.asarray(out))
+            return
+        # bf16: teacher-force the dense trajectory through both paths and
+        # compare the logits stepwise.
+        from frl_distributed_ml_scaffold_tpu.models.generation import (
+            _decode_step,
+            _prefill,
+        )
+
+        ref_np = np.asarray(ref)
+        md, mf = (m.clone(cache_len=32) for m in (model_d, model_f))
+        log_d, cache_d = _prefill(md, params, tokens, None)
+        log_f, cache_f = _prefill(mf, params, tokens, None)
+        atol = 8 * float(jnp.finfo(jnp.bfloat16).eps) * max(
+            1.0, float(np.abs(np.asarray(log_d, np.float32)).max())
+        )
+        for i in range(10, ref_np.shape[1]):
+            np.testing.assert_allclose(
+                np.asarray(log_d, np.float32), np.asarray(log_f, np.float32),
+                atol=atol, rtol=0.05,
+            )
+            tok = jnp.asarray(ref_np[:, i], jnp.int32)
+            log_d, cache_d = _decode_step(md, params, cache_d, tok)
+            log_f, cache_f = _decode_step(mf, params, cache_f, tok)
+    finally:
+        da.FORCE_INTERPRET = None
+
+
+def test_bucketed_cache_matches_full_cache(gpt):
+    """Numerics across cache buckets: the same generation run in the
+    smallest covering bucket, an oversized bucket, and the legacy
+    full-seq_len cache must agree token-for-token."""
+    from frl_distributed_ml_scaffold_tpu.models.generation import generate
+
+    model, params, tokens = gpt
+    outs = [
+        generate(model, params, tokens, max_new_tokens=6, temperature=0.0,
+                 cache_len=cl)
+        for cl in (None, 32, model.config.seq_len)
+    ]
+    for o in outs[1:]:
+        np.testing.assert_array_equal(np.asarray(outs[0]), np.asarray(o))
+
+
+def _decode_step_jaxpr(model, params, cache_len):
+    """Jaxpr of one single-token decode step at the given cache bucket."""
+    m = model.clone(cache_len=cache_len)
+    tokens = jnp.zeros((2, 1), jnp.int32)
+    # Build a cache of the right structure via a 1-token prefill.
+    _, vars_out = m.apply(
+        {"params": params}, tokens, decode=True, mutable=["cache"]
+    )
+    cache = vars_out["cache"]
+
+    def step(params, cache, tok):
+        logits, vo = m.apply(
+            {"params": params, "cache": cache}, tok, decode=True,
+            mutable=["cache"],
+        )
+        return logits, vo["cache"]
+
+    return jax.make_jaxpr(step)(params, cache, tokens)
+
+
+def _all_eqn_shapes(jaxpr, acc):
+    for eqn in jaxpr.eqns:
+        for v in eqn.outvars:
+            if hasattr(v.aval, "shape"):
+                acc.append(tuple(v.aval.shape))
+        for p in eqn.params.values():
+            ps = p if isinstance(p, (list, tuple)) else [p]
+            for u in ps:
+                if hasattr(u, "eqns"):
+                    _all_eqn_shapes(u, acc)
+                elif hasattr(u, "jaxpr") and hasattr(u.jaxpr, "eqns"):
+                    _all_eqn_shapes(u.jaxpr, acc)
+    return acc
+
+
+@pytest.mark.fast
+def test_decode_step_reads_only_active_bucket(gpt):
+    """The jaxpr pin of the acceptance gate: with the cache bucketed to 16
+    of a seq_len=96 model, the decode step must carry NO intermediate
+    sized to the full context — every cache-derived array (the cache
+    update, the [B, H, 1, S] score strip, the attention output chain) is
+    bucket-sized. seq_len appears only in the wpe PARAM (an invar, never
+    materialized per step: the position embedding is gathered per row)."""
+    model, params, _ = gpt
+    seq_len, bucket = model.config.seq_len, 16
+    jaxpr = _decode_step_jaxpr(model, params, bucket)
+    shapes = _all_eqn_shapes(jaxpr.jaxpr, [])
+    offenders = [s for s in shapes if seq_len in s]
+    assert not offenders, (
+        f"decode step materializes full-context ({seq_len}) arrays with a "
+        f"{bucket}-bucket cache: {offenders}"
+    )
+    h, hd = model.config.num_heads, model.config.hidden_dim // model.config.num_heads
+    assert any(
+        s[-3:] == (bucket, h, hd) or (bucket in s and h in s)
+        for s in shapes
+    ), "no bucket-sized cache arrays found — is decode even caching?"

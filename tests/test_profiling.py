@@ -187,3 +187,50 @@ def test_trace_overlap_classifies_ppermute_hidden_vs_exposed(capsys):
     out = capsys.readouterr().out
     assert "collective-permute: 4.00 ms, 2.00 hidden / 2.00 exposed" in out
     assert "all-gather: 2.00 ms, 1.00 hidden / 1.00 exposed" in out
+
+
+def test_trace_decode_classifies_kernel_vs_cache_update(capsys):
+    """Decode-serving classification (the serve_bench on-chip capture,
+    BACKLOG R8-1): a synthetic lane with a fused decode-attention kernel
+    span, per-row cache scatter spans, and surrounding projection fusions
+    must split the step time into kernel / cache-update / other — and the
+    printed summary must only appear when decode work is present."""
+    from tools.trace_analyze import classify_decode, decode_summary
+
+    ms = int(1e9)
+    events = [
+        ("fusion.matmul.3", 0 * ms, 4 * ms),
+        ("custom-call.decode_kernel.1", 4 * ms, 7 * ms),
+        ("dynamic-update-slice-fusion.2", 7 * ms, 8 * ms),
+        ("scatter.9", 8 * ms, 10 * ms),
+        ("fusion.sample.4", 10 * ms, 11 * ms),
+        # A sharded decode lane's collective: "reduce-scatter" must NOT
+        # substring-match the bare "scatter" cache class — comm time is
+        # classify_overlap's business, here it lands in "other".
+        ("reduce-scatter.5", 11 * ms, 13 * ms),
+    ]
+    stats = classify_decode(events)
+    assert stats["decode_kernel_ms"] == pytest.approx(3.0)
+    assert stats["cache_update_ms"] == pytest.approx(3.0)
+    assert stats["other_ms"] == pytest.approx(7.0)
+
+    class E:
+        def __init__(self, mid, start, end):
+            self.metadata_id = mid
+            self.offset_ps = start
+            self.duration_ps = end - start
+
+    class Line:
+        pass
+
+    Line.events = [E(i, a, b) for i, (_, a, b) in enumerate(events)]
+    emeta = {i: name for i, (name, _, _) in enumerate(events)}
+    decode_summary(Line(), emeta)
+    out = capsys.readouterr().out
+    assert "decode: kernel 3.00 ms" in out
+    assert "cache update 3.00 ms" in out
+
+    # A training lane (no decode kernel) prints nothing.
+    Line.events = Line.events[:1]
+    decode_summary(Line(), {0: events[0][0]})
+    assert capsys.readouterr().out == ""

@@ -1,0 +1,345 @@
+"""Serving subsystem gates (serving/engine.py + the TP-sharded decode
+path + tools/serve_bench.py).
+
+Three layers, mirroring the subsystem:
+
+- **Engine**: continuous batching over the fixed slot array — retired
+  slots are refilled and the refilled request completes correctly (the
+  acceptance gate), bucket growth, eos retirement, engine == generate()
+  on the same request.
+- **Parallel**: the sharded decode path matches the replicated path on
+  model-only and data×model sim meshes, the prefill emits the cache
+  model-sharded, and the prefill→decode handoff carries NO monolithic
+  cache reshard (jaxpr/HLO pin, the tp_overlap pin style).
+- **Bench**: tools/serve_bench.py runs end-to-end on CPU sim and emits a
+  BENCH_TABLE-schema-valid row.
+"""
+
+from __future__ import annotations
+
+import pytest as _pytest_mark
+
+pytestmark = _pytest_mark.mark.serving
+
+import dataclasses
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from _jit import jit_init
+
+from frl_distributed_ml_scaffold_tpu.config.schema import (
+    GPTConfig,
+    MeshConfig,
+    PrecisionConfig,
+)
+from frl_distributed_ml_scaffold_tpu.dist.mesh import (
+    build_mesh,
+    mesh_context,
+)
+from frl_distributed_ml_scaffold_tpu.models.generation import generate
+from frl_distributed_ml_scaffold_tpu.models.gpt import GPT, gpt_tp_rules
+from frl_distributed_ml_scaffold_tpu.parallel.partition import (
+    shard_params_for_serving,
+)
+from frl_distributed_ml_scaffold_tpu.precision import get_policy
+from frl_distributed_ml_scaffold_tpu.serving import ServingEngine
+
+FP32 = get_policy(PrecisionConfig(policy="fp32"))
+TINY = dict(
+    vocab_size=64, num_layers=2, num_heads=4, hidden_dim=64, seq_len=64,
+    dropout=0.0,
+)
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    model = GPT(GPTConfig(**TINY), FP32)
+    tokens = jax.random.randint(jax.random.key(1), (2, 8), 0, 64)
+    params = jit_init(model, tokens, train=False)["params"]
+    return model, params, tokens
+
+
+def _shard(params, env):
+    return shard_params_for_serving(params, env, gpt_tp_rules())
+
+
+# ------------------------------------------------------------------ engine
+
+
+@pytest.mark.fast
+def test_engine_matches_generate_greedy(gpt):
+    """A single request through the slot machinery must equal generate()
+    token-for-token (same shared decode entry point underneath)."""
+    model, params, _ = gpt
+    p = np.arange(5, dtype=np.int32) % 64
+    eng = ServingEngine(model, params, num_slots=2, temperature=0.0)
+    rid = eng.submit(p, max_new_tokens=6)
+    done = {c.id: c for c in eng.run()}
+    ref = generate(
+        model, params, jnp.asarray(p)[None], max_new_tokens=6,
+        temperature=0.0,
+    )
+    np.testing.assert_array_equal(done[rid].tokens, np.asarray(ref)[0])
+
+
+@pytest.mark.fast
+def test_engine_continuous_batching_refills_slots(gpt):
+    """The acceptance gate: more requests than slots — retired slots must
+    be refilled while other slots keep decoding, every refilled request
+    must complete, and each completion must equal its own single-request
+    generate() run (slot reuse cannot leak cache state)."""
+    model, params, _ = gpt
+    rng = np.random.default_rng(7)
+    reqs = {}
+    eng = ServingEngine(model, params, num_slots=3, temperature=0.0)
+    for _ in range(8):
+        l = int(rng.integers(2, 12))
+        prompt = rng.integers(0, 64, size=l).astype(np.int32)
+        n_new = int(rng.integers(2, 9))
+        rid = eng.submit(prompt, n_new)
+        reqs[rid] = (prompt, n_new)
+    done = {c.id: c for c in eng.run()}
+    assert sorted(done) == sorted(reqs), "not every request completed"
+    # 8 requests through 3 slots: at least one slot was reused, and at
+    # least one decode step ran with a mid-stream admission behind it.
+    assert eng.stats["completed"] == 8
+    assert eng.stats["decode_steps"] > 0
+    for rid, (prompt, n_new) in reqs.items():
+        ref = generate(
+            model, params, jnp.asarray(prompt)[None], max_new_tokens=n_new,
+            temperature=0.0,
+        )
+        np.testing.assert_array_equal(
+            done[rid].tokens, np.asarray(ref)[0],
+            err_msg=f"request {rid} diverged from its solo generate()",
+        )
+
+
+@pytest.mark.fast
+def test_engine_eos_retirement_frees_slot(gpt):
+    """A request hitting eos must retire early (finish_reason='eos',
+    fewer tokens than budget) and hand its slot to the next queued
+    request, which then completes."""
+    model, params, _ = gpt
+    p = np.arange(6, dtype=np.int32)
+    # Find the greedy continuation's second token and use it as eos.
+    ref = np.asarray(
+        generate(model, params, jnp.asarray(p)[None], max_new_tokens=3,
+                 temperature=0.0)
+    )[0]
+    eos = int(ref[7])
+    eng = ServingEngine(
+        model, params, num_slots=1, temperature=0.0, eos_id=eos
+    )
+    rid_a = eng.submit(p, max_new_tokens=10)
+    rid_b = eng.submit((p + 1) % 64, max_new_tokens=2)
+    done = {c.id: c for c in eng.run()}
+    assert done[rid_a].finish_reason == "eos"
+    assert len(done[rid_a].tokens) == 6 + 2  # retired at eos, not budget
+    assert rid_b in done, "freed slot was not refilled"
+    assert len(done[rid_b].tokens) == 6 + 2
+
+
+@pytest.mark.fast
+def test_engine_rejects_invalid_requests(gpt):
+    """Guard rails: empty prompts, non-positive budgets (prefill always
+    samples one token, and a seq_len prompt with budget 0 would push the
+    bucket past seq_len), and context overflow all fail at submit() —
+    never mid-loop."""
+    model, params, _ = gpt
+    with pytest.raises(ValueError, match="num_slots"):
+        ServingEngine(model, params, num_slots=0)
+    eng = ServingEngine(model, params, num_slots=1, temperature=0.0)
+    with pytest.raises(ValueError, match="empty prompt"):
+        eng.submit(np.zeros(0, np.int32), 4)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        eng.submit(np.zeros(model.config.seq_len, np.int32), 0)
+    with pytest.raises(ValueError, match="exceeds the model context"):
+        eng.submit(np.zeros(model.config.seq_len, np.int32), 1)
+
+
+@pytest.mark.fast
+def test_engine_bucket_growth_and_latency_accounting(gpt):
+    """Cache buckets grow monotonically (powers of two) only when an
+    active slot needs the room, and every completion carries per-token
+    latencies."""
+    model, params, _ = gpt
+    eng = ServingEngine(model, params, num_slots=2, temperature=0.0,
+                        min_bucket=8)
+    eng.submit(np.arange(4, dtype=np.int32), max_new_tokens=30)
+    eng.submit(np.arange(7, dtype=np.int32), max_new_tokens=5)
+    done = eng.run()
+    grows = [k for k in eng.stats if k.startswith("grow_")]
+    assert grows, f"34-token request in min_bucket=8 never grew: {dict(eng.stats)}"
+    assert len(done) == 2
+    for c in done:  # one latency per GENERATED token, every completion
+        assert len(c.token_latencies_s) == len(c.tokens) - c.prompt_len, c
+        assert all(dt > 0 for dt in c.token_latencies_s)
+
+
+# ---------------------------------------------------------------- parallel
+
+
+@pytest.mark.parametrize(
+    "mesh_kw",
+    [dict(data=1, model=8), dict(data=4, model=2)],
+    ids=["model_only", "data_x_model"],
+)
+def test_sharded_decode_matches_replicated(gpt, mesh_kw):
+    """Head-sharded serving == replicated serving, generate() AND the
+    engine, on the two acceptance meshes."""
+    model, params, tokens = gpt
+    ref = generate(model, params, tokens, max_new_tokens=5, temperature=0.0)
+    prompt = np.asarray(tokens[0], np.int32)
+    eng_ref = ServingEngine(model, params, num_slots=2, temperature=0.0)
+    rid = eng_ref.submit(prompt, 4)
+    solo_ref = {c.id: c for c in eng_ref.run()}[rid]
+
+    env = build_mesh(MeshConfig(**mesh_kw))
+    with mesh_context(env):
+        sharded = _shard(params, env)
+        out = generate(
+            model, sharded, tokens, max_new_tokens=5, temperature=0.0
+        )
+        eng = ServingEngine(model, sharded, num_slots=2, temperature=0.0)
+        rid2 = eng.submit(prompt, 4)
+        solo = {c.id: c for c in eng.run()}[rid2]
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(out))
+    np.testing.assert_array_equal(solo_ref.tokens, solo.tokens)
+
+
+def test_prefill_emits_model_sharded_cache_no_reshard_pin(gpt):
+    """The handoff pin (tp_overlap pin style): under a model-axis mesh,
+    (i) prefill EMITS the KV cache head-sharded over ``model`` — no
+    post-hoc resharding; (ii) the compiled decode step contains no
+    all-gather of a cache-shaped array (the only gathers legal in the
+    step are logit-sized); (iii) the decode step's cache output shardings
+    equal its inputs' — the layout is a fixed point of the step."""
+    model, params, _ = gpt
+    # model=4 so the 4 heads split exactly (h % model == 0 is the
+    # shard_map head-sharding contract; an indivisible mesh legally falls
+    # back to GSPMD's own split).
+    env = build_mesh(MeshConfig(data=2, model=4))
+    tp_m = 4
+    bucket = 16
+    m = model.clone(cache_len=bucket)
+    tokens = jax.random.randint(jax.random.key(5), (2, 8), 0, 64)
+
+    with mesh_context(env):
+        sharded = _shard(params, env)
+
+        @jax.jit
+        def prefill(params, toks):
+            logits, vo = m.apply(
+                {"params": params}, toks, decode=True, mutable=["cache"]
+            )
+            return logits, vo["cache"]
+
+        _, cache = prefill(sharded, tokens)
+        kv = cache["blocks"]["attn"]["cached_key"]  # [L, B, S, H, hd]
+        # The jit output sharding may surface as GSPMDSharding (no .spec);
+        # the per-device shard geometry is the layout fact that matters:
+        # the heads axis must be SPLIT over the 8-way model axis.
+        shard = kv.sharding.shard_shape(kv.shape)
+        h = model.config.num_heads
+        assert shard[3] == h // tp_m, (
+            f"prefill cache not head-sharded: global {kv.shape}, "
+            f"per-device {shard}"
+        )
+
+        @jax.jit
+        def step(params, cache, tok):
+            logits, vo = m.apply(
+                {"params": params, "cache": cache}, tok, decode=True,
+                mutable=["cache"],
+            )
+            return logits, vo["cache"]
+
+        tok = jnp.zeros((2, 1), jnp.int32)
+        compiled = step.lower(sharded, cache, tok).compile()
+        _, cache2 = step(sharded, cache, tok)
+        kv2 = cache2["blocks"]["attn"]["cached_key"]
+        assert kv2.sharding.shard_shape(kv2.shape) == shard, (
+            "decode step changed the cache layout: "
+            f"{shard} -> {kv2.sharding.shard_shape(kv2.shape)}"
+        )
+
+    # HLO pin: no all-gather whose result carries the cache's [S, H] (or
+    # sharded-H) trailing geometry — a monolithic reshard of the cache
+    # would have to materialize one.
+    txt = compiled.as_text()
+    cache_sigs = set()
+    l, b = model.config.num_layers, tokens.shape[0]
+    h, hd = model.config.num_heads, TINY["hidden_dim"] // model.config.num_heads
+    for hh in {h, h // tp_m or 1}:
+        for bb in {b, b // 2 or 1}:
+            cache_sigs.add((l, bb, bucket, hh, hd))
+            cache_sigs.add((bb, bucket, hh, hd))
+    offending = []
+    for line in txt.splitlines():
+        if "all-gather" not in line:
+            continue
+        for dims in re.findall(r"\[([0-9,]+)\]", line):
+            shape = tuple(int(x) for x in dims.split(","))
+            if shape in cache_sigs:
+                offending.append(line.strip()[:160])
+    assert not offending, (
+        "decode step all-gathers a cache-shaped array (monolithic "
+        f"reshard): {offending}"
+    )
+
+
+# ------------------------------------------------------------------- bench
+
+
+def test_serve_bench_runs_and_emits_schema_valid_row(capsys):
+    """tools/serve_bench.py end-to-end on CPU sim: continuous batching
+    completes every request (more requests than slots, so retired slots
+    are refilled and the refilled requests finish) and the emitted row
+    meets the BENCH_TABLE measured-row schema (the test_bench.py
+    contract: config + mesh + per-sample FLOPs + MFU + provenance)."""
+    import json
+
+    sys_path_mod = __import__("sys")
+    import os as _os
+
+    tools = _os.path.join(
+        _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))),
+        "tools",
+    )
+    if tools not in sys_path_mod.path:
+        sys_path_mod.path.insert(0, tools)
+    import serve_bench
+
+    rc = serve_bench.main(
+        [
+            "--preset", "tiny", "--requests", "5", "--slots", "2",
+            "--max-new", "4", "--sim-devices", "0",
+            "--arms", "dense_replicated,flash_sharded",
+        ]
+    )
+    assert rc == 0
+    lines = [
+        l for l in capsys.readouterr().out.splitlines()
+        if l.startswith("{")
+    ]
+    assert len(lines) == 2, lines
+    for line in lines:
+        row = json.loads(line)
+        for key in ("config", "samples_per_sec_per_chip", "mesh",
+                    "model_flops_per_sample", "mfu"):
+            assert key in row, f"row missing {key}"
+        assert isinstance(row["mesh"], dict) and row["mesh"]
+        assert row["model_flops_per_sample"] > 0
+        assert 0 < row["mfu"] < 1.0
+        assert re.match(r"\d{4}-\d{2}-\d{2}T", row["captured_at"])
+        s = row["serving"]
+        assert s["engine_stats"]["completed"] == 5
+        assert s["tokens_per_sec"] > 0
+        assert s["latency_p99_ms"] >= s["latency_p50_ms"] > 0
+    arms = {json.loads(l)["serving"]["arm"] for l in lines}
+    assert arms == {"dense_replicated", "flash_sharded"}

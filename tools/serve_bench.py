@@ -1,0 +1,296 @@
+#!/usr/bin/env python
+"""Serving throughput/latency A/B: dense-decode vs flash-decode, replicated
+vs model-sharded KV cache, through the continuous-batching engine.
+
+Runs end-to-end on CPU simulation (the sim devices come from
+``--sim-devices``, set BEFORE jax initializes) so the whole pipeline —
+bucketed prefill, slot grafts, decode steps, eos retirement — is exercised
+without hardware; the on-chip capture at the real operating point is the
+queued A/B (BACKLOG R8-1). Measures tokens/sec and p50/p99 per-token
+latency per arm and emits one BENCH_TABLE-schema row per arm (printed as a
+JSON line; ``--out`` appends to a file). CPU-sim rows are diagnostics —
+only on-chip rows get committed to BENCH_TABLE.jsonl.
+
+    python tools/serve_bench.py --preset tiny --requests 12 --slots 4
+    python tools/serve_bench.py --preset tiny --arms dense_replicated,flash_sharded
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--preset", default="tiny",
+                   choices=["tiny", "gpt2_medium"],
+                   help="model size (tiny = CPU-sim friendly)")
+    p.add_argument("--slots", type=int, default=4)
+    p.add_argument("--requests", type=int, default=12)
+    p.add_argument("--max-new", type=int, default=16)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--sim-devices", type=int, default=8,
+                   help="CPU-sim device count (0 = leave backend alone)")
+    p.add_argument("--arms", default="dense_replicated,flash_replicated,"
+                   "dense_sharded,flash_sharded",
+                   help="comma-separated: {dense,flash}_{replicated,sharded}")
+    p.add_argument("--model-axis", type=int, default=2,
+                   help="model-axis size for the sharded arms")
+    p.add_argument("--out", default=None,
+                   help="append emitted rows to this jsonl file")
+    return p.parse_args(argv)
+
+
+def _setup_backend(args) -> None:
+    """Must run before jax import (the conftest.py discipline)."""
+    if args.sim_devices:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags
+                + f" --xla_force_host_platform_device_count={args.sim_devices}"
+            ).strip()
+
+
+#: v5e bf16 peak — the MFU convention every BENCH_TABLE row uses; on CPU
+#: sim the resulting mfu is a nominal tiny-but-positive placeholder.
+_PEAK_FLOPS = 197e12
+
+
+def _build(preset: str):
+    import jax
+
+    from frl_distributed_ml_scaffold_tpu.config.schema import (
+        GPTConfig,
+        PrecisionConfig,
+    )
+    from frl_distributed_ml_scaffold_tpu.models.gpt import GPT
+    from frl_distributed_ml_scaffold_tpu.precision import get_policy
+
+    if preset == "tiny":
+        cfg = GPTConfig(
+            vocab_size=256, num_layers=2, num_heads=4, hidden_dim=64,
+            seq_len=256, dropout=0.0,
+        )
+    else:
+        cfg = GPTConfig(
+            vocab_size=50257, num_layers=24, num_heads=16, hidden_dim=1024,
+            seq_len=1024, dropout=0.0,
+        )
+    model = GPT(cfg, get_policy(PrecisionConfig(policy="fp32")))
+    tokens = jax.random.randint(
+        jax.random.key(0), (2, 8), 0, cfg.vocab_size
+    )
+    params = jax.jit(
+        lambda: model.init(
+            {"params": jax.random.key(0)}, tokens, train=False
+        )["params"]
+    )()
+    return model, params
+
+
+def _workload(cfg, n_requests: int, max_new: int, seed: int):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    ceil = max(4, min(cfg.seq_len - max_new - 1, cfg.seq_len // 4))
+    work = []
+    for _ in range(n_requests):
+        l = int(rng.integers(2, ceil))
+        n_new = int(rng.integers(max(1, max_new // 2), max_new + 1))
+        # Clamp to the model context so an aggressive --max-new degrades
+        # to shorter generations instead of aborting the A/B at submit().
+        work.append(
+            (
+                rng.integers(0, cfg.vocab_size, size=l).astype(np.int32),
+                max(1, min(n_new, cfg.seq_len - l)),
+            )
+        )
+    return work
+
+
+def _decode_flops_per_token(model, params, num_slots: int) -> int:
+    """Jaxpr-counted FLOPs of one decode step / slots (the per-token cost
+    at full occupancy — the utils/flops.py counter, same convention as the
+    BENCH_TABLE backfills)."""
+    import jax
+    import jax.numpy as jnp
+
+    from frl_distributed_ml_scaffold_tpu.utils.flops import fn_flops
+
+    m = model.clone(cache_len=model.config.seq_len)
+    tok = jnp.zeros((num_slots, 1), jnp.int32)
+    _, vars_out = m.apply(
+        {"params": params}, tok, decode=True, mutable=["cache"]
+    )
+    cache = vars_out["cache"]
+
+    def step(params, cache, tok):
+        out, vo = m.apply(
+            {"params": params, "cache": cache}, tok, decode=True,
+            mutable=["cache"],
+        )
+        return out, vo["cache"]
+
+    return fn_flops(step, params, cache, tok) // num_slots
+
+
+def run_arm(model, params, arm: str, args, flops_per_token: int) -> dict:
+    """One (decode impl, sharding) arm through the engine; returns the
+    BENCH_TABLE-schema row."""
+    import dataclasses
+    import datetime
+
+    import jax
+    import numpy as np
+
+    from frl_distributed_ml_scaffold_tpu.config.schema import MeshConfig
+    from frl_distributed_ml_scaffold_tpu.dist.mesh import (
+        build_mesh,
+        mesh_context,
+    )
+    from frl_distributed_ml_scaffold_tpu.models.gpt import GPT, gpt_tp_rules
+    from frl_distributed_ml_scaffold_tpu.parallel.partition import (
+        shard_params_for_serving,
+    )
+    from frl_distributed_ml_scaffold_tpu.serving import ServingEngine
+
+    impl, sharding = arm.split("_")
+    m = dataclasses.replace(model.config, decode_attention=impl)
+    model = GPT(m, model.policy)
+
+    mesh_sizes = {"pipe": 1, "data": 1, "fsdp": 1, "seq": 1, "expert": 1,
+                  "model": 1}
+    if sharding == "sharded":
+        n = len(jax.devices())
+        tp = args.model_axis
+        if n % tp != 0 or model.config.num_heads % tp != 0:
+            raise ValueError(
+                f"sharded arm needs model axis {tp} dividing both device "
+                f"count {n} and num_heads {model.config.num_heads}"
+            )
+        env = build_mesh(MeshConfig(data=n // tp, model=tp))
+        mesh_sizes.update(data=n // tp, model=tp)
+        ctx = mesh_context(env)
+        with ctx:
+            run_params = shard_params_for_serving(params, env, gpt_tp_rules())
+    else:
+        ctx = mesh_context(None)
+        run_params = params
+
+    work = _workload(model.config, args.requests, args.max_new, args.seed)
+    with ctx:
+        eng = ServingEngine(
+            model, run_params, num_slots=args.slots, temperature=0.0
+        )
+        # Warm-up pass: the SAME workload once through the engine, so
+        # every compiled shape the measured pass will hit (each prompt
+        # bucket's prefill, each cache bucket's decode step, the grafts
+        # and growths between them) is already in the jit caches — the
+        # timed window must measure serving, not XLA compilation, or the
+        # A/B reads as whichever arm compiles fewer programs. The cache
+        # state is then RESET so the measured pass replays the same
+        # bucket trajectory (same shapes, warm) instead of decoding
+        # everything at the warm pass's terminal bucket.
+        for prompt, n_new in work:
+            eng.submit(prompt, n_new)
+        eng.run()
+        eng.reset_cache()
+        for prompt, n_new in work:
+            eng.submit(prompt, n_new)
+        t0 = time.perf_counter()
+        done = eng.run()
+        wall = time.perf_counter() - t0
+    assert len(done) == len(work), (len(done), len(work))
+
+    lat = np.asarray(
+        [dt for c in done for dt in c.token_latencies_s], np.float64
+    )
+    n_tokens = int(sum(len(c.tokens) - c.prompt_len for c in done))
+    n_chips = len(jax.devices())
+    tok_per_sec = n_tokens / wall
+    chip = jax.devices()[0].device_kind
+    per_chip = tok_per_sec / n_chips
+    row = {
+        "config": f"serve_bench_{args.preset}",
+        "model": "gpt",
+        "mesh": mesh_sizes,
+        "param_sharding": "tp" if sharding == "sharded" else "replicated",
+        "precision": "fp32",
+        "grad_accum": 1,
+        "remat": "none",
+        "global_batch_size": args.slots,
+        "per_chip_batch_size": args.slots,
+        "n_chips": n_chips,
+        "chip": chip,
+        # Serving semantics: a "sample" is one generated token.
+        "samples_per_sec_per_chip": round(per_chip, 3),
+        "step_time_median_s": round(float(np.median(lat)), 6),
+        "model_flops_per_sample": int(flops_per_token),
+        "mfu": max(1e-9, flops_per_token * per_chip / _PEAK_FLOPS),
+        "serving": {
+            "arm": arm,
+            "decode_attention": impl,
+            "kv_cache_sharding": sharding,
+            "tokens_per_sec": round(tok_per_sec, 3),
+            "latency_p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 3),
+            "latency_p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 3),
+            "requests": len(work),
+            "slots": args.slots,
+            "engine_stats": dict(eng.stats),
+        },
+        "note": (
+            "continuous-batching serve bench (tools/serve_bench.py): "
+            "tokens/sec and per-token latency through serving/engine.py; "
+            "CPU-sim rows are diagnostics, the on-chip A/B at the "
+            "gpt2_medium operating point is BACKLOG R8-1"
+        ),
+        "captured_at": datetime.datetime.now(datetime.timezone.utc)
+        .strftime("%Y-%m-%dT%H:%M:%SZ"),
+    }
+    return row
+
+
+def main(argv=None) -> int:
+    args = _parse_args(argv)
+    _setup_backend(args)
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import jax
+
+    if args.sim_devices:
+        jax.config.update("jax_platforms", "cpu")
+
+    model, params = _build(args.preset)
+    flops = _decode_flops_per_token(model, params, args.slots)
+    rows = []
+    for arm in args.arms.split(","):
+        arm = arm.strip()
+        if not arm:
+            continue
+        row = run_arm(model, params, arm, args, flops)
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    if args.out:
+        with open(args.out, "a") as fh:
+            for row in rows:
+                fh.write(json.dumps(row) + "\n")
+
+    # Human-readable A/B summary.
+    for row in rows:
+        s = row["serving"]
+        print(
+            f"# {s['arm']:>18s}: {s['tokens_per_sec']:9.1f} tok/s  "
+            f"p50 {s['latency_p50_ms']:7.2f} ms  "
+            f"p99 {s['latency_p99_ms']:7.2f} ms",
+            file=sys.stderr,
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -77,6 +77,66 @@ def _intersection_len(xs, ys):
     return total
 
 
+#: Decode-serving op classes (tools/serve_bench.py captures): the fused
+#: decode kernel lowers to a Mosaic custom-call whose fusion/op names embed
+#: the pallas kernel symbol; KV-cache writes are the per-row scatter /
+#: dynamic-update-slice the gpt.py decode path emits. First match wins,
+#: like COMM_OPS.
+DECODE_KERNEL_OPS = ("decode_kernel", "flash_decode", "decode_attention")
+CACHE_UPDATE_OPS = ("dynamic-update-slice", "dynamic_update_slice", "scatter")
+
+
+def classify_decode(events) -> dict:
+    """Decode-serving time split for one device timeline: fused
+    decode-attention kernel time vs KV-cache update time vs everything
+    else (projections, embedding, sampling). ``events`` is the same
+    ``(name, start_ps, end_ps)`` span form ``classify_overlap`` takes —
+    tests feed synthetic spans, ``main`` feeds the XLA Ops lane. Durations
+    are summed per class (not interval-unioned: the question here is
+    where the step's device time GOES, not what overlaps what)."""
+    out = {"decode_kernel_ms": 0.0, "cache_update_ms": 0.0, "other_ms": 0.0}
+    for name, a, b in events:
+        dur = (b - a) / 1e9
+        if any(k in name for k in DECODE_KERNEL_OPS):
+            out["decode_kernel_ms"] += dur
+        elif comm_class(name) is not None:
+            # Collectives before the cache check: a sharded decode lane
+            # carries e.g. "reduce-scatter" fusions whose name would
+            # otherwise substring-match the bare "scatter" cache class —
+            # comm time belongs to classify_overlap, not the cache split.
+            out["other_ms"] += dur
+        elif any(k in name for k in CACHE_UPDATE_OPS):
+            out["cache_update_ms"] += dur
+        else:
+            out["other_ms"] += dur
+    return out
+
+
+def decode_summary(line, emeta) -> None:
+    """Print the decode-serving split for one XLA Ops lane when the lane
+    actually contains decode-attention kernel work (the serve_bench
+    on-chip capture, BACKLOG R8-1)."""
+    events = [
+        (emeta[e.metadata_id], e.offset_ps, e.offset_ps + e.duration_ps)
+        for e in line.events
+    ]
+    if not any(
+        any(k in name for k in DECODE_KERNEL_OPS) for name, _, _ in events
+    ):
+        return
+    stats = classify_decode(events)
+    total = sum(stats.values())
+    if total <= 0.0:
+        return
+    print(
+        f"  decode: kernel {stats['decode_kernel_ms']:.2f} ms "
+        f"({100.0 * stats['decode_kernel_ms'] / total:.1f}%), "
+        f"cache update {stats['cache_update_ms']:.2f} ms "
+        f"({100.0 * stats['cache_update_ms'] / total:.1f}%), "
+        f"other {stats['other_ms']:.2f} ms"
+    )
+
+
 def classify_overlap(events) -> dict:
     """Comm-vs-compute overlap stats for one device timeline.
 
@@ -209,6 +269,7 @@ def main() -> int:
                     f"  {ps / 1e9 / n_steps:8.2f} {n_events[name]:6d}  {name[:120]}"
                 )
             overlap_summary(line, emeta)
+            decode_summary(line, emeta)
     return 0
 
 
