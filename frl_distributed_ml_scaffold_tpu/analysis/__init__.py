@@ -1,0 +1,26 @@
+"""graft-lint: jaxpr/HLO static analysis for performance invariants.
+
+The subsystem behind ``tools/graft_lint.py`` and the ``analysis.pins``
+pytest API (docs/static_analysis.md).  Five passes over three program
+artifacts:
+
+====================  ==========================  =======================
+pass                  artifact                    module
+====================  ==========================  =======================
+collective census     closed jaxpr + HLO text     analysis.collectives
+reshard detector      jaxpr + compiled HLO        analysis.reshard
+materialization       closed jaxpr                analysis.materialization
+donation audit        lowered + compiled text     analysis.donation
+traced-code hygiene   Python AST                  analysis.hygiene
+====================  ==========================  =======================
+
+``analysis.pins`` wraps the passes as test assertions; ``analysis.runner``
+drives them over every registered recipe.  Keep jax imports lazy at the
+module level so ``tools/graft_lint.py`` can set platform env vars first.
+"""
+
+from frl_distributed_ml_scaffold_tpu.analysis.findings import (  # noqa: F401
+    Finding,
+    Report,
+)
+from frl_distributed_ml_scaffold_tpu.analysis import pins  # noqa: F401

@@ -1,0 +1,250 @@
+"""Pass 1 — collective census.
+
+Every collective in a program, with axis names, element counts and
+estimated bytes, at two levels:
+
+- **jaxpr level** (hand-placed collectives: the explicit fsdp_overlap
+  gathers/scatters, tp_overlap ppermute rings, pipeline collectives) —
+  GSPMD-inserted collectives do NOT exist at this level; and
+- **HLO level** (``lowered.as_text()`` / ``compiled.as_text()``) — where
+  GSPMD's partitioner has already inserted its collectives, so the diff
+  jaxpr-census vs HLO-census is exactly "what GSPMD added".
+
+Census rows are diffable across two program versions (``census_diff``):
+the promoted form of PR 3's "4 rings/block, zero all_gather" pin is "the
+census of the step is unchanged".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any
+
+from frl_distributed_ml_scaffold_tpu.analysis.jaxpr_utils import (
+    aval_bytes,
+    close,
+    iter_eqns,
+)
+
+# Exact jaxpr primitive names of the cross-device collectives.
+COLLECTIVE_PRIMITIVES = (
+    "all_gather",
+    "reduce_scatter",
+    "ppermute",
+    "psum",
+    "all_to_all",
+    "pbroadcast",
+    "pmax",
+    "pmin",
+)
+
+# HLO op mnemonics (compiled text); -start suffixes are the async forms.
+HLO_COLLECTIVES = (
+    "all-reduce",
+    "all-gather",
+    "reduce-scatter",
+    "collective-permute",
+    "all-to-all",
+    "collective-broadcast",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveRecord:
+    """One collective equation occurrence."""
+
+    primitive: str
+    axes: tuple[str, ...]
+    shapes: tuple[tuple[int, ...], ...]  # output shapes
+    dtype: str
+    bytes_per_call: int
+    trip_count: int  # product of enclosing scan lengths
+    path: tuple[str, ...]  # enclosing primitive names (scan, custom_vjp, ...)
+
+    @property
+    def total_bytes(self) -> int:
+        return self.bytes_per_call * self.trip_count
+
+    def key(self) -> tuple:
+        """Identity for census diffing: where the eqn sits, what moves,
+        and how often — trip_count included so a scan-length change (same
+        eqn, 12x the wire bytes) still registers as drift."""
+        return (
+            self.primitive, self.axes, self.shapes, self.dtype, self.path,
+            self.trip_count,
+        )
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "primitive": self.primitive,
+            "axes": list(self.axes),
+            "shapes": [list(s) for s in self.shapes],
+            "dtype": self.dtype,
+            "bytes_per_call": self.bytes_per_call,
+            "trip_count": self.trip_count,
+            "total_bytes": self.total_bytes,
+            "path": list(self.path),
+        }
+
+
+def _eqn_axes(eqn: Any) -> tuple[str, ...]:
+    """Axis names of a collective eqn (``axes`` on psum/pmax/pmin,
+    ``axis_name`` on the rest), normalized to a string tuple."""
+    for k in ("axes", "axis_name"):
+        if k in eqn.params:
+            v = eqn.params[k]
+            if isinstance(v, (tuple, list)):
+                return tuple(str(a) for a in v)
+            return (str(v),)
+    return ()
+
+
+def collective_census(jaxpr: Any) -> list[CollectiveRecord]:
+    """All collective eqns in the program (sub-jaxprs included)."""
+    records = []
+    for eqn, path, trips in iter_eqns(close(jaxpr)):
+        name = str(eqn.primitive)
+        if name not in COLLECTIVE_PRIMITIVES:
+            continue
+        shapes = tuple(
+            tuple(getattr(v.aval, "shape", ())) for v in eqn.outvars
+        )
+        dtype = str(getattr(eqn.outvars[0].aval, "dtype", "?")) if eqn.outvars else "?"
+        nbytes = sum(aval_bytes(v.aval) for v in eqn.outvars)
+        records.append(
+            CollectiveRecord(
+                primitive=name,
+                axes=_eqn_axes(eqn),
+                shapes=shapes,
+                dtype=dtype,
+                bytes_per_call=nbytes,
+                trip_count=trips,
+                path=path,
+            )
+        )
+    return records
+
+
+def census_summary(records: list[CollectiveRecord]) -> dict[str, Any]:
+    """Aggregate census: per primitive, counts and total bytes."""
+    agg: dict[str, dict[str, int]] = {}
+    for r in records:
+        a = agg.setdefault(
+            r.primitive, {"eqns": 0, "calls": 0, "total_bytes": 0}
+        )
+        a["eqns"] += 1
+        a["calls"] += r.trip_count
+        a["total_bytes"] += r.total_bytes
+    return agg
+
+
+def census_diff(
+    old: list[CollectiveRecord], new: list[CollectiveRecord]
+) -> dict[str, list[dict[str, Any]]]:
+    """Diff two censuses by record identity; multiplicity-aware.
+
+    Returns ``{"added": [...], "removed": [...]}`` where each entry is the
+    record dict plus a ``count`` delta — the artifact to stare at when a
+    refactor changes a step's communication.
+    """
+
+    def counted(records):
+        acc: dict[tuple, list[CollectiveRecord]] = {}
+        for r in records:
+            acc.setdefault(r.key(), []).append(r)
+        return acc
+
+    o, n = counted(old), counted(new)
+    added, removed = [], []
+    for k in n.keys() - o.keys():
+        added.append({**n[k][0].to_dict(), "count": len(n[k])})
+    for k in o.keys() - n.keys():
+        removed.append({**o[k][0].to_dict(), "count": len(o[k])})
+    for k in o.keys() & n.keys():
+        d = len(n[k]) - len(o[k])
+        if d > 0:
+            added.append({**n[k][0].to_dict(), "count": d})
+        elif d < 0:
+            removed.append({**o[k][0].to_dict(), "count": -d})
+    return {"added": added, "removed": removed}
+
+
+# --------------------------------------------------------------------- HLO
+
+# Dtype tokens are letters possibly mixed with digits (f32, bf16, pred,
+# f8e4m3fn) — a letters-then-digits pattern would miss pred entirely.
+_HLO_SHAPE = re.compile(r"\b([a-z][a-z0-9]*)\[([0-9,]*)\]")
+_HLO_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class HloCollective:
+    """One collective op line in HLO/StableHLO text."""
+
+    op: str  # e.g. "all-gather"
+    shapes: tuple[tuple[int, ...], ...]  # result shapes on the line
+    dtypes: tuple[str, ...]
+    bytes_total: int
+    line: str
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "op": self.op,
+            "shapes": [list(s) for s in self.shapes],
+            "dtypes": list(self.dtypes),
+            "bytes_total": self.bytes_total,
+            "line": self.line[:200],
+        }
+
+
+def hlo_collective_census(text: str) -> list[HloCollective]:
+    """Collective ops in compiled (or lowered) HLO text.
+
+    Matches the op mnemonic as the instruction being assigned on each
+    line (``%x = f32[...] all-gather(...)``; async ``-start`` forms are
+    counted once, their ``-done`` halves skipped), and records every
+    result shape on the left of the op name — that is the materialized
+    result, i.e. the wire cost upper bound.
+    """
+    out = []
+    for raw in text.splitlines():
+        line = raw.strip()
+        for op in HLO_COLLECTIVES:
+            # "<shapes> op(" or "<shapes> op-start("; skip -done/-update.
+            m = re.search(rf"=\s+(.*?)\s({op})(-start)?\(", line)
+            if not m:
+                continue
+            lhs = m.group(1)
+            shapes, dtypes, nbytes = [], [], 0
+            for dt, dims in _HLO_SHAPE.findall(lhs):
+                shape = tuple(int(x) for x in dims.split(",")) if dims else ()
+                shapes.append(shape)
+                dtypes.append(dt)
+                n = 1
+                for d in shape:
+                    n *= d
+                nbytes += n * _HLO_DTYPE_BYTES.get(dt, 4)
+            out.append(
+                HloCollective(
+                    op=op,
+                    shapes=tuple(shapes),
+                    dtypes=tuple(dtypes),
+                    bytes_total=nbytes,
+                    line=line,
+                )
+            )
+            break
+    return out
+
+
+def hlo_census_summary(records: list[HloCollective]) -> dict[str, Any]:
+    agg: dict[str, dict[str, int]] = {}
+    for r in records:
+        a = agg.setdefault(r.op, {"ops": 0, "total_bytes": 0})
+        a["ops"] += 1
+        a["total_bytes"] += r.bytes_total
+    return agg

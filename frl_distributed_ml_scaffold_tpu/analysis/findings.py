@@ -1,0 +1,131 @@
+"""Structured findings: the common currency of every graft-lint pass.
+
+A pass inspects one program artifact (closed jaxpr, lowered StableHLO,
+compiled HLO, or Python source) and emits ``Finding`` records; a
+``Report`` aggregates them per linted program and serializes to the JSON
+the CLI emits.  Severity semantics are fixed repo-wide:
+
+- ``error``   — a pinned performance invariant is violated; the CLI exits
+                non-zero (and ``analysis.pins`` raises AssertionError).
+- ``warning`` — suspicious but not pinned (e.g. a numpy call inside a
+                traced function that may be shape-time arithmetic).
+- ``info``    — observability output (collective census rows, largest
+                intermediates) used for diffing program versions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Iterable
+
+SEVERITIES = ("error", "warning", "info")
+
+
+@dataclasses.dataclass
+class Finding:
+    """One analyzer observation, machine-readable and diffable."""
+
+    pass_name: str  # "collective_census" | "reshard" | "materialization" | "donation" | "hygiene"
+    severity: str   # "error" | "warning" | "info"
+    code: str       # stable short slug, e.g. "exposed-all-gather"
+    message: str    # human-readable one-liner
+    context: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        if self.severity not in SEVERITIES:
+            raise ValueError(
+                f"severity {self.severity!r} not in {SEVERITIES}"
+            )
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "pass": self.pass_name,
+            "severity": self.severity,
+            "code": self.code,
+            "message": self.message,
+            "context": _jsonable(self.context),
+        }
+
+
+def _jsonable(obj: Any) -> Any:
+    """Best-effort conversion of context payloads (shape tuples, dtypes,
+    numpy scalars) into JSON-serializable structures."""
+    if isinstance(obj, dict):
+        return {str(k): _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple, set)):
+        return [_jsonable(v) for v in obj]
+    if isinstance(obj, (str, bool, type(None))):
+        return obj
+    if isinstance(obj, (int, float)):
+        return obj
+    if hasattr(obj, "item"):  # numpy scalar
+        return obj.item()
+    return str(obj)
+
+
+@dataclasses.dataclass
+class Report:
+    """All findings for one linted program (e.g. one recipe's train step)."""
+
+    program: str
+    findings: list[Finding] = dataclasses.field(default_factory=list)
+    meta: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def add(
+        self,
+        pass_name: str,
+        severity: str,
+        code: str,
+        message: str,
+        **context: Any,
+    ) -> Finding:
+        f = Finding(pass_name, severity, code, message, context)
+        self.findings.append(f)
+        return f
+
+    def extend(self, findings: Iterable[Finding]) -> None:
+        self.findings.extend(findings)
+
+    def errors(self) -> list[Finding]:
+        return [f for f in self.findings if f.severity == "error"]
+
+    def warnings(self) -> list[Finding]:
+        return [f for f in self.findings if f.severity == "warning"]
+
+    @property
+    def ok(self) -> bool:
+        return not self.errors()
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "program": self.program,
+            "ok": self.ok,
+            "counts": {
+                s: sum(1 for f in self.findings if f.severity == s)
+                for s in SEVERITIES
+            },
+            "meta": _jsonable(self.meta),
+            "findings": [f.to_dict() for f in self.findings],
+        }
+
+    def to_json(self, **kw: Any) -> str:
+        return json.dumps(self.to_dict(), **kw)
+
+    def summary_lines(self, *, max_info: int = 0) -> list[str]:
+        """Human-readable per-program summary for the CLI table."""
+        counts = self.to_dict()["counts"]
+        head = (
+            f"{'FAIL' if not self.ok else 'ok  '} {self.program}: "
+            f"{counts['error']} error(s), {counts['warning']} warning(s), "
+            f"{counts['info']} info"
+        )
+        lines = [head]
+        shown_info = 0
+        for f in self.findings:
+            if f.severity == "info":
+                shown_info += 1
+                if shown_info > max_info:
+                    continue
+            lines.append(f"    [{f.severity}] {f.pass_name}/{f.code}: {f.message}")
+        return lines

@@ -1,0 +1,260 @@
+"""Pass 5 — traced-code hygiene lint (AST level, no tracing needed).
+
+Static Python-source checks for the bug classes that only bite under
+``jit``:
+
+- **host-sync** (error): ``.item()`` / ``jax.device_get`` / ``np.asarray``
+  inside a function that manipulates tracers — each is a device→host
+  round trip that serializes the step (SURVEY call stack (b): the host's
+  only per-step job is dispatch).
+- **python-rng** (error): stdlib ``random.*`` or ``np.random.*`` inside
+  traced code — traced once, frozen forever; every step replays the
+  values baked in at trace time.
+- **axis-typo** (error): a string axis name passed to a collective or
+  ``shard_map`` that is not one of the mesh's axes.  GSPMD errors on
+  these eventually, but from deep inside a trace with an opaque message;
+  the lint names the file/line.
+- **host-sync-cast** (warning): ``float()``/``int()``/``bool()`` on an
+  operand that provably references array code (jnp/lax/jax in its
+  subtree) inside traced code — on a tracer each is a device→host sync.
+  Shape-time casts (``float(np.prod(shape))``, config ints) stay quiet.
+- **numpy-in-traced** (warning): other ``np.*`` calls inside a traced
+  function.  Often legal shape-time arithmetic (``np.prod(shape)``), so
+  an allowlist of shape-time helpers keeps this quiet; the rest is worth
+  a look — on a tracer it either crashes or silently constant-folds.
+
+"Traced function" is approximated as: a function whose body references
+``jnp.`` / ``jax.lax`` / ``lax.`` — exactly the modules the repo's traced
+code imports. Host-side orchestration (engine scheduling, data loading)
+does not match and is not linted.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Any, Iterable
+
+from frl_distributed_ml_scaffold_tpu.analysis.findings import Finding
+
+# Axes of the repo's meshes (config.schema.MeshConfig fields).
+DEFAULT_KNOWN_AXES = frozenset(
+    {"data", "fsdp", "model", "pipe", "seq", "expert"}
+)
+
+# lax collectives and the positional index their axis name rides at
+# (psum(x, axis_name) → 1; axis_index(axis_name) → 0), besides axis_name=.
+_COLLECTIVE_FNS = {
+    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1, "ppermute": 1,
+    "all_gather": 1, "psum_scatter": 1, "all_to_all": 1, "pswapaxes": 1,
+    "axis_index": 0, "axis_size": 0,
+}
+
+# np.* attrs that are legitimately shape-time inside traced code.
+_NP_SHAPE_TIME = {
+    "prod", "dtype", "float32", "float16", "bfloat16", "float64", "int32",
+    "int64", "int8", "uint8", "bool_", "ndarray", "shape", "ceil", "floor",
+    "log2", "sqrt", "pi", "inf", "finfo", "iinfo", "arange", "cumsum",
+    "lcm", "gcd", "isscalar",
+}
+
+_HOST_SYNC_CALLS = {"device_get", "block_until_ready"}
+_NP_HOST_SYNC = {"asarray", "array"}
+
+
+def _dotted(node: ast.AST) -> str:
+    """'np.random.randint' for nested Attribute/Name chains, '' otherwise."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_array_expr(node: ast.AST) -> bool:
+    """Does the expression subtree reference jnp/lax/jax — i.e. is its
+    value provably an array (tracer) rather than host shape math?"""
+    for sub in ast.walk(node):
+        if isinstance(sub, (ast.Attribute, ast.Name)):
+            d = _dotted(sub)
+            if d.startswith(("jnp.", "lax.", "jax.")):
+                return True
+    return False
+
+
+def _is_traced_fn(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        d = _dotted(node) if isinstance(node, (ast.Attribute, ast.Name)) else ""
+        if d.startswith(("jnp.", "lax.", "jax.lax", "jax.nn")):
+            return True
+    return False
+
+
+def _axis_literals(call: ast.Call) -> list[str]:
+    """String axis names passed to a collective-ish call."""
+    out = []
+
+    def strings(node):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            out.append(node.value)
+        elif isinstance(node, (ast.Tuple, ast.List)):
+            for e in node.elts:
+                strings(e)
+
+    for kw in call.keywords:
+        if kw.arg in ("axis_name", "axes"):
+            strings(kw.value)
+    name = _dotted(call.func)
+    leaf = name.rsplit(".", 1)[-1]
+    pos = _COLLECTIVE_FNS.get(leaf)
+    if pos is not None and len(call.args) > pos:
+        strings(call.args[pos])
+    return out
+
+
+def lint_source(
+    source: str,
+    filename: str = "<source>",
+    *,
+    known_axes: Iterable[str] = DEFAULT_KNOWN_AXES,
+    extra_axes: Iterable[str] = (),
+) -> list[Finding]:
+    """Lint one module's (or function's) source text."""
+    known = set(known_axes) | set(extra_axes)
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:  # pragma: no cover - repo sources parse
+        return [
+            Finding(
+                "hygiene", "warning", "unparseable",
+                f"{filename}: {e}", {"file": filename},
+            )
+        ]
+    findings: list[Finding] = []
+
+    def where(node: ast.AST) -> dict[str, Any]:
+        return {"file": filename, "line": getattr(node, "lineno", 0)}
+
+    # Walk top-level and nested function defs; lint only traced-looking ones.
+    for fn in [
+        n for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]:
+        traced = _is_traced_fn(fn)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted(node.func)
+            leaf = name.rsplit(".", 1)[-1]
+            # Axis-name typos: checked in every function — the literal is
+            # an axis name regardless of how host-y the caller looks.
+            for ax in _axis_literals(node):
+                if ax not in known:
+                    findings.append(
+                        Finding(
+                            "hygiene", "error", "axis-typo",
+                            f"{filename}:{node.lineno} function "
+                            f"{fn.name!r} uses unknown mesh axis {ax!r} "
+                            f"(known: {sorted(known)})",
+                            {**where(node), "axis": ax, "function": fn.name},
+                        )
+                    )
+            if not traced:
+                continue
+            if name.startswith(("random.", "np.random.", "numpy.random.")):
+                findings.append(
+                    Finding(
+                        "hygiene", "error", "python-rng",
+                        f"{filename}:{node.lineno} function {fn.name!r} "
+                        f"calls {name} inside traced code — the value is "
+                        "baked in at trace time; use jax.random",
+                        {**where(node), "call": name, "function": fn.name},
+                    )
+                )
+            elif (
+                leaf == "item"
+                and isinstance(node.func, ast.Attribute)
+                or leaf in _HOST_SYNC_CALLS
+                and name.startswith("jax.")
+            ):
+                findings.append(
+                    Finding(
+                        "hygiene", "error", "host-sync",
+                        f"{filename}:{node.lineno} function {fn.name!r} "
+                        f"calls {name or leaf}() inside traced code — a "
+                        "device→host sync per step",
+                        {**where(node), "call": name or leaf,
+                         "function": fn.name},
+                    )
+                )
+            elif (
+                name in ("float", "int", "bool")
+                and node.args
+                and _is_array_expr(node.args[0])
+            ):
+                # float(tracer)/int(tracer) forces a device→host sync
+                # (ISSUE host-sync class). Flagged only when the operand
+                # subtree provably references array code (jnp/lax/jax) —
+                # float(np.prod(x.shape)) and float(static_config_arg)
+                # are legal shape-time arithmetic and stay quiet.
+                findings.append(
+                    Finding(
+                        "hygiene", "warning", "host-sync-cast",
+                        f"{filename}:{node.lineno} function {fn.name!r} "
+                        f"calls {name}() on a non-literal inside traced "
+                        "code — on a tracer this is a per-step host sync "
+                        "(use the array dtype ops instead)",
+                        {**where(node), "call": name, "function": fn.name},
+                    )
+                )
+            elif name.startswith(("np.", "numpy.")):
+                attr = name.split(".", 1)[1]
+                root = attr.split(".", 1)[0]
+                if root in _NP_HOST_SYNC:
+                    findings.append(
+                        Finding(
+                            "hygiene", "error", "host-sync",
+                            f"{filename}:{node.lineno} function "
+                            f"{fn.name!r} calls {name}() inside traced "
+                            "code — materializes the tracer on host",
+                            {**where(node), "call": name,
+                             "function": fn.name},
+                        )
+                    )
+                elif root not in _NP_SHAPE_TIME:
+                    findings.append(
+                        Finding(
+                            "hygiene", "warning", "numpy-in-traced",
+                            f"{filename}:{node.lineno} function "
+                            f"{fn.name!r} calls {name}() inside traced "
+                            "code — on a tracer this crashes or "
+                            "constant-folds silently",
+                            {**where(node), "call": name,
+                             "function": fn.name},
+                        )
+                    )
+    return findings
+
+
+def lint_file(
+    path: str,
+    *,
+    known_axes: Iterable[str] = DEFAULT_KNOWN_AXES,
+    extra_axes: Iterable[str] = (),
+) -> list[Finding]:
+    with open(path) as fh:
+        return lint_source(
+            fh.read(), path, known_axes=known_axes, extra_axes=extra_axes
+        )
+
+
+def lint_fn(fn: Any, **kw: Any) -> list[Finding]:
+    """Lint one Python function object (via its source)."""
+    import inspect
+    import textwrap
+
+    src = textwrap.dedent(inspect.getsource(fn))
+    filename = f"{getattr(fn, '__module__', '?')}.{getattr(fn, '__qualname__', '?')}"
+    return lint_source(src, filename, **kw)

@@ -1,0 +1,94 @@
+"""Shared jaxpr traversal for every analyzer pass (and ``analysis.pins``).
+
+This is THE walker the per-test copies in tests/test_tp_overlap.py,
+tests/test_fsdp_overlap.py and tests/test_decode_attention.py grew from —
+promoted here so every pin and pass agrees on what "recurse into
+sub-jaxprs" means: scan/while/cond bodies, pjit/remat calls, custom-VJP
+closures, and shard_map regions are all descended.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator
+
+import numpy as np
+
+
+def subjaxprs(eqn: Any) -> Iterator[Any]:
+    """Yield every sub-jaxpr reachable from one equation's params
+    (ClosedJaxpr's inner jaxpr included)."""
+    for v in eqn.params.values():
+        vs = v if isinstance(v, (list, tuple)) else [v]
+        for u in vs:
+            if hasattr(u, "eqns"):
+                yield u
+            elif hasattr(u, "jaxpr") and hasattr(u.jaxpr, "eqns"):
+                yield u.jaxpr
+
+
+def iter_eqns(
+    jaxpr: Any, _path: tuple[str, ...] = (), _trips: int = 1
+) -> Iterator[tuple[Any, tuple[str, ...], int]]:
+    """Yield ``(eqn, enclosing_primitive_path, trip_count)`` over the whole
+    program, depth-first.
+
+    ``trip_count`` multiplies the static trip counts of enclosing scans
+    (``scan.length``) so a collective inside the layer scan is counted
+    once per layer — the number that matters for bytes-on-the-wire.
+    """
+    for eqn in jaxpr.eqns:
+        yield eqn, _path, _trips
+        name = str(eqn.primitive)
+        trips = _trips
+        if name == "scan":
+            trips *= int(eqn.params.get("length", 1) or 1)
+        for sub in subjaxprs(eqn):
+            yield from iter_eqns(sub, _path + (name,), trips)
+
+
+def close(jaxpr: Any) -> Any:
+    """Accept either a ClosedJaxpr or a raw Jaxpr and return the raw one."""
+    return jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr
+
+
+def primitive_shapes(
+    jaxpr: Any, prim_name: str
+) -> list[tuple[tuple[int, ...], ...]]:
+    """Output shapes of every eqn whose primitive name CONTAINS
+    ``prim_name`` (substring, the historical test-pin contract), one tuple
+    of out-shapes per matching eqn, sub-jaxprs included."""
+    found = []
+    for eqn, _path, _trips in iter_eqns(close(jaxpr)):
+        if prim_name in str(eqn.primitive):
+            found.append(tuple(v.aval.shape for v in eqn.outvars))
+    return found
+
+
+def eqn_output_shapes(jaxpr: Any) -> list[tuple[int, ...]]:
+    """Every eqn output shape in the program (the decode-pin walker)."""
+    acc = []
+    for eqn, _path, _trips in iter_eqns(close(jaxpr)):
+        for v in eqn.outvars:
+            if hasattr(v.aval, "shape"):
+                acc.append(tuple(v.aval.shape))
+    return acc
+
+
+def aval_bytes(aval: Any) -> int:
+    """Bytes of one abstract value; extended dtypes (PRNG keys) fall back
+    to their element-type itemsize, shapeless avals count zero."""
+    shape = getattr(aval, "shape", None)
+    if shape is None:
+        return 0
+    dtype = getattr(aval, "dtype", None)
+    itemsize = getattr(dtype, "itemsize", None)
+    if itemsize is None:
+        itemsize = 4
+    return int(np.prod(shape, dtype=np.int64)) * int(itemsize) if shape else int(itemsize)
+
+
+def top_level_scans(jaxpr: Any) -> list[Any]:
+    """The top-level scan eqns of a program (forward/backward layer loops,
+    grad-accum microbatch loop) — the granularity the blockwise pins count
+    collectives at."""
+    return [e for e in close(jaxpr).eqns if str(e.primitive) == "scan"]

@@ -1,0 +1,125 @@
+"""Pass 3 — materialization budget.
+
+Generalizes PR 4's decode pin ("no full-seq_len arrays in a bucketed
+decode step"): every eqn OUTPUT in a program is an array the step may
+materialize; any one larger than the per-recipe byte budget — or carrying
+a forbidden dimension — is a finding.  Program INPUTS (params, caches)
+are exempt by construction: only eqn outvars are walked, so a big weight
+passing through untouched never trips the budget, exactly like the
+original pin's "seq_len appears only in the wpe PARAM" carve-out.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+from frl_distributed_ml_scaffold_tpu.analysis.findings import Finding
+from frl_distributed_ml_scaffold_tpu.analysis.jaxpr_utils import (
+    aval_bytes,
+    close,
+    iter_eqns,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Intermediate:
+    shape: tuple[int, ...]
+    dtype: str
+    bytes: int
+    primitive: str
+    path: tuple[str, ...]
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "shape": list(self.shape),
+            "dtype": self.dtype,
+            "bytes": self.bytes,
+            "primitive": self.primitive,
+            "path": list(self.path),
+        }
+
+
+def intermediates(jaxpr: Any) -> list[Intermediate]:
+    """Every eqn output in the program, with its byte size."""
+    out = []
+    for eqn, path, _trips in iter_eqns(close(jaxpr)):
+        for v in eqn.outvars:
+            aval = v.aval
+            if not hasattr(aval, "shape"):
+                continue
+            out.append(
+                Intermediate(
+                    shape=tuple(aval.shape),
+                    dtype=str(getattr(aval, "dtype", "?")),
+                    bytes=aval_bytes(aval),
+                    primitive=str(eqn.primitive),
+                    path=path,
+                )
+            )
+    return out
+
+
+def max_materialized_bytes(jaxpr: Any) -> int:
+    """The largest single intermediate in the program (bytes)."""
+    return max((i.bytes for i in intermediates(jaxpr)), default=0)
+
+
+def oversized_intermediates(
+    jaxpr: Any, budget_bytes: int
+) -> list[Intermediate]:
+    """Intermediates whose single-array size exceeds the budget."""
+    return [i for i in intermediates(jaxpr) if i.bytes > budget_bytes]
+
+
+def intermediates_with_dim(jaxpr: Any, dim: int) -> list[Intermediate]:
+    """Intermediates carrying ``dim`` in their shape — the decode pin's
+    "full-seq_len array materialized" detector."""
+    return [i for i in intermediates(jaxpr) if dim in i.shape]
+
+
+def materialization_findings(
+    jaxpr: Any,
+    *,
+    budget_bytes: int | None = None,
+    forbidden_dim: int | None = None,
+    top_k: int = 3,
+    label: str = "",
+) -> list[Finding]:
+    """Budget + forbidden-dim checks as findings; always reports the
+    ``top_k`` largest intermediates as info rows (the diffable census of
+    where the memory goes)."""
+    out: list[Finding] = []
+    ints = intermediates(jaxpr)
+    for i in sorted(ints, key=lambda x: -x.bytes)[:top_k]:
+        out.append(
+            Finding(
+                "materialization", "info", "largest-intermediate",
+                f"{label}{i.dtype}{list(i.shape)} = {i.bytes} bytes "
+                f"({i.primitive})",
+                {"intermediate": i.to_dict()},
+            )
+        )
+    if budget_bytes is not None:
+        for i in ints:
+            if i.bytes > budget_bytes:
+                out.append(
+                    Finding(
+                        "materialization", "error", "over-budget",
+                        f"{label}intermediate {i.dtype}{list(i.shape)} is "
+                        f"{i.bytes} bytes > budget {budget_bytes} "
+                        f"({i.primitive} at {'/'.join(i.path) or 'top'})",
+                        {"intermediate": i.to_dict(), "budget": budget_bytes},
+                    )
+                )
+    if forbidden_dim is not None:
+        for i in (x for x in ints if forbidden_dim in x.shape):
+            out.append(
+                Finding(
+                    "materialization", "error", "forbidden-dim",
+                    f"{label}intermediate {i.dtype}{list(i.shape)} carries "
+                    f"forbidden dim {forbidden_dim} ({i.primitive})",
+                    {"intermediate": i.to_dict(), "dim": forbidden_dim},
+                )
+            )
+    return out

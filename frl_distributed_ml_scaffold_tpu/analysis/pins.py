@@ -1,0 +1,270 @@
+"""The pytest assertion API over the analyzer passes.
+
+``analysis.pins`` is how a perf PR's headline property becomes a pinned
+invariant: one assertion per property, raising ``AssertionError`` with
+the offending shapes/lines, built on the same walkers the ``graft_lint``
+CLI runs.  The pre-existing ad-hoc pins map as:
+
+- PR 2 "blockwise gathers, reduce-scatter backward"
+    → ``assert_all_gather_outputs_within`` + ``scan_collective_counts``
+      + ``assert_collective_present``.
+- PR 3 "4 rings/block, zero all_gather on pure TP"
+    → ``assert_no_collective`` + ``scan_collective_counts``.
+- PR 4 "no full-seq_len arrays in a bucketed decode step"
+    → ``assert_no_dim_materialized`` / ``assert_max_materialized_bytes``.
+- PR 4 "prefill→decode handoff reshard-free in compiled HLO"
+    → ``assert_reshard_free``.
+- PR 5 "state/cache donated and actually aliased"
+    → ``assert_donated`` / ``assert_aliased``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Sequence
+
+from frl_distributed_ml_scaffold_tpu.analysis.collectives import (
+    collective_census,
+    hlo_collective_census,
+)
+from frl_distributed_ml_scaffold_tpu.analysis.donation import (
+    compiled_aliases,
+    lowered_donations,
+)
+from frl_distributed_ml_scaffold_tpu.analysis.jaxpr_utils import (
+    eqn_output_shapes,
+    primitive_shapes,
+    top_level_scans,
+)
+from frl_distributed_ml_scaffold_tpu.analysis.materialization import (
+    intermediates_with_dim,
+    max_materialized_bytes,
+    oversized_intermediates,
+)
+from frl_distributed_ml_scaffold_tpu.analysis.reshard import (
+    monolithic_gathers,
+    reshard_findings,
+)
+
+__all__ = [
+    "collective_census",
+    "eqn_output_shapes",
+    "primitive_shapes",
+    "scan_collective_counts",
+    "assert_no_collective",
+    "assert_collective_present",
+    "assert_all_gather_outputs_within",
+    "assert_max_materialized_bytes",
+    "assert_no_dim_materialized",
+    "assert_donated",
+    "assert_aliased",
+    "assert_reshard_free",
+]
+
+
+# ------------------------------------------------------------ jaxpr pins
+
+
+def scan_collective_counts(jaxpr: Any, prim_name: str) -> list[int]:
+    """Per top-level scan eqn: how many ``prim_name`` eqns its body
+    carries (sub-jaxprs included) — the blockwise-schedule pin: the layer
+    scans, not the top level, must own the collectives."""
+    return [
+        len(primitive_shapes(s.params["jaxpr"], prim_name))
+        for s in top_level_scans(jaxpr)
+    ]
+
+
+def _fail(msg: str | None, detail: str) -> str:
+    """Compose the AssertionError text: a custom ``msg`` prefixes the
+    computed offender detail rather than replacing it — the whole point
+    of a pin firing is seeing WHAT tripped it."""
+    return f"{msg}: {detail}" if msg else detail
+
+
+def assert_no_collective(
+    jaxpr: Any, prim_name: str, msg: str | None = None
+) -> None:
+    """No eqn whose primitive name contains ``prim_name`` anywhere."""
+    found = primitive_shapes(jaxpr, prim_name)
+    assert not found, _fail(
+        msg,
+        f"program contains {len(found)} {prim_name!r} eqn(s) "
+        f"(output shapes {found[:4]}...) but is pinned {prim_name}-free",
+    )
+
+
+def assert_collective_present(
+    jaxpr: Any, prim_name: str, msg: str | None = None
+) -> list[tuple]:
+    """At least one ``prim_name`` eqn; returns the matches for further
+    shape-level assertions."""
+    found = primitive_shapes(jaxpr, prim_name)
+    assert found, _fail(
+        msg,
+        f"program carries no {prim_name!r} eqn but is pinned to contain "
+        "at least one",
+    )
+    return found
+
+
+def assert_all_gather_outputs_within(
+    jaxpr: Any,
+    allowed_shapes: Iterable[tuple[int, ...]],
+    msg: str | None = None,
+) -> None:
+    """Every all_gather output shape is one of ``allowed_shapes`` (the
+    per-block param slices an overlap schedule may legally move)."""
+    bad = monolithic_gathers(jaxpr, allowed_shapes)
+    assert not bad, _fail(
+        msg,
+        f"all_gather outputs {bad} are not per-block param slices — an "
+        "activation (or full stacked tensor) passed through a monolithic "
+        "gather",
+    )
+
+
+# -------------------------------------------------------- materialization
+
+
+def assert_max_materialized_bytes(
+    jaxpr: Any, budget_bytes: int, msg: str | None = None
+) -> None:
+    over = oversized_intermediates(jaxpr, budget_bytes)
+    assert not over, _fail(
+        msg,
+        "intermediates exceed the materialization budget "
+        f"({budget_bytes} bytes): "
+        + ", ".join(
+            f"{i.dtype}{list(i.shape)}={i.bytes}B" for i in over[:5]
+        )
+        + (f" (+{len(over) - 5} more)" if len(over) > 5 else "")
+        + f"; max={max_materialized_bytes(jaxpr)}B",
+    )
+
+
+def assert_no_dim_materialized(
+    jaxpr: Any, dim: int, msg: str | None = None
+) -> None:
+    """No eqn output carries ``dim`` in its shape — inputs (params) are
+    exempt, exactly the decode pin's wpe carve-out."""
+    hits = intermediates_with_dim(jaxpr, dim)
+    assert not hits, _fail(
+        msg,
+        f"program materializes arrays carrying forbidden dim {dim}: "
+        + str(sorted({i.shape for i in hits})),
+    )
+
+
+# --------------------------------------------------------------- donation
+
+
+def assert_donated(
+    lowered_or_text: Any,
+    *,
+    min_donated: int = 1,
+    arg_paths: Sequence[str] | None = None,
+    expect_donated: Callable[[str], bool] | None = None,
+    msg: str | None = None,
+) -> None:
+    """The lowered program donates its buffers.
+
+    With ``arg_paths`` + ``expect_donated``, every expected leaf must
+    carry a donation marker; otherwise at least ``min_donated`` args must.
+    """
+    dons = lowered_donations(lowered_or_text)
+    if arg_paths is not None and expect_donated is not None:
+        assert len(arg_paths) == len(dons), (
+            f"cannot map {len(dons)} lowered args onto {len(arg_paths)} "
+            "tree leaves — pass the exact example args the jit sees"
+        )
+        missing = [
+            p
+            for d, p in zip(dons, arg_paths)
+            if expect_donated(p) and not d.donated
+        ]
+        assert not missing, (
+            msg
+            or f"args expected donated carry no donation marker: "
+            f"{missing[:6]}" + ("..." if len(missing) > 6 else "")
+        )
+        return
+    n = sum(1 for d in dons if d.donated)
+    assert n >= min_donated, (
+        msg
+        or f"only {n}/{len(dons)} lowered args are donated "
+        f"(pinned >= {min_donated}) — a donate_argnums went missing"
+    )
+
+
+def assert_aliased(
+    compiled_or_text: Any, *, min_aliases: int = 1, msg: str | None = None
+) -> list[dict]:
+    """The compiled executable actually aliases >= ``min_aliases``
+    input/output pairs (donation that the compiler accepted); returns the
+    alias table for finer-grained checks."""
+    aliases = compiled_aliases(compiled_or_text)
+    assert len(aliases) >= min_aliases, (
+        msg
+        or f"compiled executable aliases only {len(aliases)} buffers "
+        f"(pinned >= {min_aliases}) — donation did not take"
+    )
+    return aliases
+
+
+# ------------------------------------------------------------------- HLO
+
+
+def assert_reshard_free(
+    compiled_or_text: Any,
+    shape_signatures: Iterable[tuple[int, ...]],
+    *,
+    ops: Sequence[str] = ("all-gather", "all-to-all", "collective-permute"),
+    msg: str | None = None,
+) -> None:
+    """No collective in compiled HLO materializes an array with one of
+    the given shape signatures (the pinned-layout arrays a GSPMD reshard
+    would have to gather)."""
+    text = (
+        compiled_or_text
+        if isinstance(compiled_or_text, str)
+        else compiled_or_text.as_text()
+    )
+    bad = reshard_findings(text, shape_signatures, ops=ops)
+    assert not bad, _fail(
+        msg,
+        "compiled HLO reshards pinned-layout arrays: "
+        + "; ".join(f.message for f in bad[:3]),
+    )
+
+
+def assert_no_collective_hlo(
+    compiled_or_text: Any,
+    op: str,
+    msg: str | None = None,
+) -> None:
+    """No HLO collective of class ``op`` (e.g. "all-gather") at all."""
+    text = (
+        compiled_or_text
+        if isinstance(compiled_or_text, str)
+        else compiled_or_text.as_text()
+    )
+    hits = [r for r in hlo_collective_census(text) if r.op == op]
+    assert not hits, _fail(
+        msg,
+        f"compiled HLO carries {len(hits)} {op} op(s): "
+        + "; ".join(r.line[:100] for r in hits[:3]),
+    )
+
+
+# ---------------------------------------------------------------- helpers
+
+
+def arg_paths_of(*example_args: Any) -> list[str]:
+    """Flattened key paths of a call's positional args, in the order jit
+    lowers them — the mapping ``assert_donated`` consumes."""
+    import jax
+
+    return [
+        jax.tree_util.keystr(path)
+        for path, _ in jax.tree_util.tree_leaves_with_path(example_args)
+    ]

@@ -1,0 +1,119 @@
+"""Pass 2 — exposed-collective / reshard detector.
+
+Two complementary detectors for the invariants PRs 2-4 pinned:
+
+- **jaxpr level** (``monolithic_gather_findings``): on an overlap-
+  scheduled path every hand-placed ``all_gather`` must move a per-block
+  PARAM slice; an all_gather whose output is not in the allowed-shapes
+  set is a monolithic activation (or stacked-model) gather — the exact
+  regression the fsdp_overlap/tp_overlap pins guard against.
+
+- **HLO level** (``exposed_collective_findings`` / ``reshard_findings``):
+  GSPMD inserts collectives at partitioning time, so they only exist in
+  lowered/compiled text.  ``exposed_collective_findings`` reports every
+  collective of the named classes (the mutation test re-enables plain
+  GSPMD TP and asserts this fires); ``reshard_findings`` flags
+  collectives whose result carries one of a set of shape signatures —
+  the "prefill→decode handoff is reshard-free" pin: a GSPMD repartition
+  of the KV cache has to materialize a cache-shaped gather.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Sequence
+
+from frl_distributed_ml_scaffold_tpu.analysis.collectives import (
+    HloCollective,
+    hlo_collective_census,
+)
+from frl_distributed_ml_scaffold_tpu.analysis.findings import Finding
+from frl_distributed_ml_scaffold_tpu.analysis.jaxpr_utils import (
+    primitive_shapes,
+)
+
+
+def monolithic_gathers(
+    jaxpr: Any, allowed_shapes: Iterable[tuple[int, ...]]
+) -> list[tuple[int, ...]]:
+    """all_gather output shapes NOT in ``allowed_shapes`` (each a
+    per-block param-slice shape the overlap schedule is allowed to move)."""
+    allowed = set(tuple(s) for s in allowed_shapes)
+    bad = []
+    for out_shapes in primitive_shapes(jaxpr, "all_gather"):
+        for shape in out_shapes:
+            if tuple(shape) not in allowed:
+                bad.append(tuple(shape))
+    return bad
+
+
+def monolithic_gather_findings(
+    jaxpr: Any,
+    allowed_shapes: Iterable[tuple[int, ...]],
+    *,
+    label: str = "",
+) -> list[Finding]:
+    return [
+        Finding(
+            "reshard", "error", "monolithic-gather",
+            f"{label}all_gather output {list(s)} is not a per-block param "
+            "slice — an activation (or full stacked tensor) passed through "
+            "a monolithic gather",
+            {"shape": list(s)},
+        )
+        for s in monolithic_gathers(jaxpr, allowed_shapes)
+    ]
+
+
+def exposed_collectives(
+    hlo_text: str, ops: Sequence[str] = ("all-gather", "all-reduce")
+) -> list[HloCollective]:
+    """Collectives of the named HLO classes present in compiled text."""
+    return [r for r in hlo_collective_census(hlo_text) if r.op in ops]
+
+
+def exposed_collective_findings(
+    hlo_text: str,
+    *,
+    ops: Sequence[str] = ("all-gather", "all-reduce"),
+    severity: str = "error",
+    label: str = "",
+) -> list[Finding]:
+    """One finding per exposed collective of the named classes — used on
+    paths pinned collective-free (pure-TP overlap: zero all-gather)."""
+    return [
+        Finding(
+            "reshard", severity, "exposed-collective",
+            f"{label}{r.op} of {[list(s) for s in r.shapes]} "
+            f"({r.bytes_total} bytes) in compiled HLO",
+            {"collective": r.to_dict()},
+        )
+        for r in exposed_collectives(hlo_text, ops)
+    ]
+
+
+def reshard_findings(
+    hlo_text: str,
+    shape_signatures: Iterable[tuple[int, ...]],
+    *,
+    ops: Sequence[str] = ("all-gather", "all-to-all", "collective-permute"),
+    label: str = "",
+) -> list[Finding]:
+    """Collectives whose RESULT carries one of the given shape signatures
+    — a GSPMD-inserted reshard of that array (the serving handoff pin)."""
+    sigs = set(tuple(s) for s in shape_signatures)
+    out = []
+    for r in hlo_collective_census(hlo_text):
+        if r.op not in ops:
+            continue
+        hit = [s for s in r.shapes if tuple(s) in sigs]
+        if hit:
+            out.append(
+                Finding(
+                    "reshard", "error", "reshard",
+                    f"{label}{r.op} materializes pinned-layout array "
+                    f"{[list(s) for s in hit]} — a monolithic reshard",
+                    {"collective": r.to_dict(),
+                     "matched": [list(s) for s in hit]},
+                )
+            )
+    return out

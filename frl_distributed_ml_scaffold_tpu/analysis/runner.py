@@ -1,0 +1,503 @@
+"""graft-lint driver: run the analyzer passes over every registered
+recipe's train step (and the serving decode step) on the CPU-sim mesh.
+
+Everything here is TRACE-ONLY: the train step is inspected via
+``jax.make_jaxpr`` on abstract inputs (the Trainer's ``state_shapes``
+eval_shape tree) and via AOT ``.lower()`` — no XLA compile, so linting
+all 17 recipes stays inside the fast-tier budget.  Compile-level checks
+(GSPMD-inserted collectives, executable alias tables) are the pin tests'
+job, which afford one tiny compile each.
+
+Per-recipe invariants enforced as ``severity:error``:
+
+- donation: every params/opt-state leaf of the train state is donated in
+  the lowered step (the jit's ``donate_argnums=(0,)`` actually took).
+- tp_overlap recipes: zero ``all_gather`` eqns on a pure-TP mesh (PR 3's
+  pin, now recipe-level).
+- fsdp_overlap recipes: every ``all_gather`` output is a per-block param
+  slice and the gathers sit inside scan bodies; an explicit
+  ``reduce_scatter`` exists (PR 2's pins).
+- optional materialization budget (``--budget-mb``).
+
+The serving decode lint builds the tiny-GPT decode step at a 16-token
+bucket of a 64-token model and pins: no full-``seq_len`` intermediate
+(PR 4), and the engine's decode/graft programs donate the cache (PR 5's
+leak fix).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable
+
+from frl_distributed_ml_scaffold_tpu.analysis.collectives import (
+    census_summary,
+    collective_census,
+)
+from frl_distributed_ml_scaffold_tpu.analysis.donation import (
+    donation_findings,
+)
+from frl_distributed_ml_scaffold_tpu.analysis.findings import Report
+from frl_distributed_ml_scaffold_tpu.analysis.jaxpr_utils import (
+    top_level_scans,
+)
+from frl_distributed_ml_scaffold_tpu.analysis.materialization import (
+    materialization_findings,
+)
+from frl_distributed_ml_scaffold_tpu.analysis.pins import (
+    primitive_shapes,
+    scan_collective_counts,
+)
+from frl_distributed_ml_scaffold_tpu.analysis.reshard import (
+    monolithic_gather_findings,
+)
+
+_COMMON = [
+    "precision.policy=fp32",
+    "trainer.log_every=100000",
+    "checkpoint.enabled=false",
+    "optimizer.warmup_steps=0",
+]
+
+_GPT_TINY = [
+    "model.vocab_size=128", "model.num_layers=2", "model.num_heads=4",
+    "model.hidden_dim=64", "model.seq_len=32",
+    "data.vocab_size=128", "data.seq_len=32", "data.global_batch_size=16",
+    "trainer.grad_accum=2",
+]
+
+_VIT_TINY = [
+    "model.image_size=32", "model.patch_size=8", "model.hidden_dim=64",
+    "model.num_layers=2", "model.num_heads=4", "model.num_classes=8",
+    "data.image_size=32", "data.num_classes=8", "data.global_batch_size=16",
+]
+
+_RN_TINY = [
+    "model.depth=10", "model.num_classes=8",
+    "data.image_size=32", "data.num_classes=8", "data.global_batch_size=16",
+]
+
+_VIDEO_TINY = [
+    "model.image_size=16", "model.num_frames=4", "model.tubelet_size=2,8,8",
+    "model.hidden_dim=64", "model.num_layers=2", "model.num_heads=4",
+    "model.num_classes=8",
+    "data.image_size=16", "data.num_frames=4", "data.num_classes=8",
+    "data.global_batch_size=16",
+]
+
+_PP_TINY = [
+    "model.vocab_size=128", "model.num_layers=8", "model.num_heads=2",
+    "model.hidden_dim=32", "model.seq_len=32",
+    "model.pipeline_microbatches=4",
+    "data.vocab_size=128", "data.seq_len=32", "data.global_batch_size=8",
+    "trainer.grad_accum=1",
+]
+
+# CPU-sim (8 virtual devices) shrink overrides per registered recipe —
+# the test_recipes.py discipline, centralized. A NEW recipe must either
+# inherit a family entry below or add its own; ``lint_recipe`` raises on
+# unknown names so the CLI catches unshrunk recipes instead of tracing a
+# 345M-param program.
+RECIPE_OVERRIDES: dict[str, list[str]] = {
+    "mnist_mlp": ["data.global_batch_size=16"],
+    "imagenet_rn50_ddp": _RN_TINY + ["mesh.data=8"],
+    "imagenet_rn101_ddp": _RN_TINY + ["model.depth=10", "mesh.data=8"],
+    "imagenet_vitb_fsdp": _VIT_TINY
+    + ["mesh.fsdp=8", "parallel.fsdp_min_size=64"],
+    "imagenet_vitl_fsdp": _VIT_TINY
+    + ["mesh.fsdp=8", "parallel.fsdp_min_size=64", "trainer.remat=none"],
+    "gpt2_medium_zero1": _GPT_TINY + ["mesh.fsdp=8"],
+    "gpt2_medium_adafactor": _GPT_TINY + ["mesh.fsdp=8"],
+    "ego4d_video_elastic": _VIDEO_TINY
+    + ["mesh.fsdp=8", "parallel.fsdp_min_size=64"],
+    "gpt2_medium_fsdp_overlap": _GPT_TINY
+    + ["mesh.fsdp=8", "parallel.fsdp_min_size=16"],
+    "gpt2_medium_tp_overlap": _GPT_TINY
+    + ["mesh.data=1", "mesh.model=8"],
+    "gpt2_tp": _GPT_TINY + ["mesh.data=4", "mesh.model=2"],
+    "gpt2_ring": [
+        "model.vocab_size=128", "model.num_layers=2", "model.num_heads=4",
+        "model.hidden_dim=64", "model.seq_len=64",
+        "data.vocab_size=128", "data.seq_len=64", "data.global_batch_size=8",
+        "mesh.data=2", "mesh.seq=4",
+    ],
+    "gpt2_long": [
+        "model.vocab_size=128", "model.num_layers=2", "model.num_heads=4",
+        "model.hidden_dim=64", "model.seq_len=256", "model.lm_loss_chunk=64",
+        "data.vocab_size=128", "data.seq_len=256", "data.global_batch_size=8",
+        "trainer.grad_accum=2", "mesh.data=8",
+    ],
+    "gpt2_moe": [
+        "model.vocab_size=128", "model.num_layers=2", "model.num_heads=4",
+        "model.hidden_dim=64", "model.seq_len=32", "model.moe.num_experts=4",
+        "data.vocab_size=128", "data.seq_len=32", "data.global_batch_size=16",
+        "mesh.data=2", "mesh.expert=4",
+    ],
+    "gpt2_pp": _PP_TINY + ["mesh.pipe=4", "mesh.data=2"],
+    "gpt2_pp_circular": _PP_TINY + ["mesh.pipe=4", "mesh.data=2"],
+    "gpt2_medium_serve": _GPT_TINY + ["mesh.data=4", "mesh.model=2"],
+}
+
+
+def _build_trainer(name: str, workdir: str):
+    from frl_distributed_ml_scaffold_tpu.config import (
+        apply_overrides,
+        get_config,
+    )
+    from frl_distributed_ml_scaffold_tpu.dist.mesh import build_mesh
+    from frl_distributed_ml_scaffold_tpu.trainer.loop import Trainer
+
+    if name not in RECIPE_OVERRIDES:
+        raise KeyError(
+            f"recipe {name!r} has no CPU-sim shrink overrides in "
+            "analysis.runner.RECIPE_OVERRIDES — add one so graft_lint "
+            "traces a tiny twin, not the production shapes"
+        )
+    cfg = apply_overrides(
+        get_config(name),
+        _COMMON + RECIPE_OVERRIDES[name] + [f"workdir={workdir}"],
+    )
+    return Trainer(cfg, mesh_env=build_mesh(cfg.mesh))
+
+
+def _abstract_batch(trainer) -> Any:
+    import jax
+    import numpy as np
+
+    from frl_distributed_ml_scaffold_tpu.trainer.tasks import example_input
+
+    example = example_input(
+        trainer.cfg.data, trainer.cfg.model,
+        batch_size=trainer.cfg.data.global_batch_size,
+    )
+    return {
+        k: jax.ShapeDtypeStruct(np.shape(v), np.asarray(v).dtype)
+        for k, v in example.items()
+    }
+
+
+def _param_slice_shapes(state_shapes, model_axis: int) -> set[tuple]:
+    """Legal all_gather output shapes for an overlap schedule: per-block
+    slices of the stacked block params, with Megatron-split dims also
+    allowed at 1/model_axis (the per-shard view inside shard_map)."""
+    import jax
+
+    slices: set[tuple] = set()
+    blocks = getattr(state_shapes.params, "get", lambda *_: None)("blocks")
+    leaves = jax.tree.leaves(blocks) if blocks is not None else []
+    if not leaves:  # non-scanned families: any full param leaf is a block
+        leaves = jax.tree.leaves(state_shapes.params)
+        for l in leaves:
+            slices.add(tuple(l.shape))
+    for l in leaves:
+        s = tuple(l.shape[1:]) if blocks is not None else tuple(l.shape)
+        slices.add(s)
+        if model_axis > 1:
+            for i, d in enumerate(s):
+                if d % model_axis == 0:
+                    slices.add(s[:i] + (d // model_axis,) + s[i + 1:])
+    return slices
+
+
+def lint_train_step(
+    name: str,
+    *,
+    workdir: str = "/tmp/graft_lint",
+    budget_bytes: int | None = None,
+) -> Report:
+    """Lint one registered recipe's train step; returns its Report."""
+    import jax
+
+    report = Report(program=f"recipe:{name}")
+    trainer = _build_trainer(name, workdir)
+    cfg = trainer.cfg
+    state_shapes = trainer.state_shapes
+    batch = _abstract_batch(trainer)
+
+    jaxpr = trainer._mesh_scoped(jax.make_jaxpr(trainer._train_step_fn))(
+        state_shapes, batch
+    )
+
+    # -- pass 1: collective census (info; the diffable artifact) --------
+    census = collective_census(jaxpr)
+    report.meta["collective_census"] = [r.to_dict() for r in census]
+    for prim, agg in sorted(census_summary(census).items()):
+        report.add(
+            "collective_census", "info", "census",
+            f"{prim}: {agg['eqns']} eqn(s), {agg['calls']} call(s)/step, "
+            f"{agg['total_bytes']} bytes",
+            primitive=prim, **agg,
+        )
+
+    # -- pass 2: exposed-collective invariants on overlap recipes -------
+    if cfg.parallel.tp_overlap and cfg.mesh.data == 1 and not (
+        cfg.parallel.param_sharding == "fsdp"
+    ):
+        # Pure-TP collective-matmul schedule: the activation gathers ARE
+        # the ppermute rings; any explicit all_gather is a regression.
+        gathers = primitive_shapes(jaxpr, "all_gather")
+        for shapes in gathers:
+            report.add(
+                "reshard", "error", "exposed-all-gather",
+                f"tp_overlap step carries an explicit all_gather of "
+                f"{[list(s) for s in shapes]} — activations must ride "
+                "the ppermute rings",
+                shapes=[list(s) for s in shapes],
+            )
+        if not primitive_shapes(jaxpr, "ppermute"):
+            report.add(
+                "reshard", "error", "missing-rings",
+                "tp_overlap step carries no ppermute rings",
+            )
+    if cfg.parallel.fsdp_overlap:
+        model_axis = trainer.env.axis_size("model")
+        slices = _param_slice_shapes(state_shapes, model_axis)
+        report.extend(
+            monolithic_gather_findings(
+                jaxpr, slices, label=f"{name}: "
+            )
+        )
+        if not primitive_shapes(jaxpr, "reduce_scatter"):
+            report.add(
+                "reshard", "error", "missing-reduce-scatter",
+                f"{name}: fsdp_overlap step has no explicit "
+                "reduce_scatter — gradients leave blocks gathered",
+            )
+        if top_level_scans(jaxpr) and not any(
+            n > 0 for n in scan_collective_counts(jaxpr, "all_gather")
+        ):
+            report.add(
+                "reshard", "error", "hoisted-gathers",
+                f"{name}: no scan body carries the explicit gathers — "
+                "they were hoisted out of the layer loop",
+            )
+
+    # -- pass 3: materialization census / budget ------------------------
+    report.extend(
+        materialization_findings(
+            jaxpr, budget_bytes=budget_bytes, label=f"{name}: "
+        )
+    )
+
+    # -- pass 4: donation audit on the lowered step ---------------------
+    lowered = trainer._mesh_scoped(trainer._train_step_jit.lower)(
+        state_shapes, batch
+    )
+    from frl_distributed_ml_scaffold_tpu.analysis.donation import (
+        args_info_donations,
+        lowered_donations,
+    )
+
+    pairs = args_info_donations(lowered)
+    text_donated = sum(
+        1 for d in lowered_donations(lowered.as_text()) if d.donated
+    )
+    if pairs is None:
+        # Old jax without args_info: fall back to marker counting.
+        report.extend(
+            donation_findings(lowered.as_text(), label=f"{name}: ")
+        )
+        if text_donated == 0:
+            report.add(
+                "donation", "error", "not-donated",
+                f"{name}: no lowered argument carries a donation marker "
+                "— donate_argnums went missing",
+            )
+        return report
+    missing = [
+        p
+        for p, donated in pairs
+        if (".params" in p or ".opt_state" in p) and not donated
+    ]
+    n_donated = sum(1 for _, d in pairs if d)
+    report.add(
+        "donation", "info", "summary",
+        f"{name}: {n_donated}/{len(pairs)} arg leaves donated "
+        f"({text_donated} donation markers survive in lowered StableHLO)",
+        donated=n_donated, args=len(pairs), markers=text_donated,
+    )
+    for p in missing:
+        report.add(
+            "donation", "error", "not-donated",
+            f"{name}: state leaf {p} is not donated — resident train "
+            "state doubles",
+            path=p,
+        )
+    if n_donated and text_donated == 0:
+        report.add(
+            "donation", "error", "donation-dropped",
+            f"{name}: donation requested for {n_donated} leaves but no "
+            "marker survives in the lowered module — lowering dropped "
+            "the donation",
+        )
+    return report
+
+
+def lint_decode_step(
+    *, seq_len: int = 96, bucket: int = 16, num_slots: int = 2
+) -> Report:
+    """Lint the serving decode path (tiny GPT, bucketed cache): PR 4's
+    no-full-seq_len pin as a materialization-budget finding, plus the
+    engine decode/graft donation audit."""
+    import jax
+    import jax.numpy as jnp
+
+    from frl_distributed_ml_scaffold_tpu.config.schema import (
+        GPTConfig,
+        PrecisionConfig,
+    )
+    from frl_distributed_ml_scaffold_tpu.models.generation import (
+        _decode_step,
+    )
+    from frl_distributed_ml_scaffold_tpu.models.gpt import GPT
+    from frl_distributed_ml_scaffold_tpu.precision import get_policy
+    from frl_distributed_ml_scaffold_tpu.serving.engine import ServingEngine
+
+    report = Report(program="serving:decode_step")
+    model = GPT(
+        GPTConfig(
+            vocab_size=64, num_layers=2, num_heads=2, hidden_dim=32,
+            seq_len=seq_len, dropout=0.0,
+        ),
+        get_policy(PrecisionConfig(policy="fp32")),
+    )
+    m = model.clone(cache_len=bucket)
+    tok = jax.ShapeDtypeStruct((num_slots, 1), jnp.int32)
+    params = jax.eval_shape(
+        lambda: model.init(
+            {"params": jax.random.key(0)},
+            jnp.zeros((num_slots, 4), jnp.int32),
+            train=False,
+        )["params"]
+    )
+    _, cache_vars = jax.eval_shape(
+        lambda p, t: m.apply(
+            {"params": p}, t, decode=True, mutable=["cache"]
+        ),
+        params, tok,
+    )
+    cache = cache_vars["cache"]
+
+    jaxpr = jax.make_jaxpr(
+        lambda p, c, t: _decode_step(m, p, c, t[:, 0])
+    )(params, cache, tok)
+
+    census = collective_census(jaxpr)
+    report.meta["collective_census"] = [r.to_dict() for r in census]
+    report.extend(
+        materialization_findings(
+            jaxpr, forbidden_dim=seq_len, label="decode_step: "
+        )
+    )
+
+    # Engine decode/graft donation: the KV cache is the serving-side
+    # optimizer state — it must be donated or every decode step holds
+    # two caches live.
+    from frl_distributed_ml_scaffold_tpu.analysis.donation import (
+        lowered_donations,
+    )
+
+    from frl_distributed_ml_scaffold_tpu.analysis.donation import (
+        args_info_donations,
+    )
+
+    eng = ServingEngine(model, params, num_slots=num_slots, temperature=0.0)
+    rng = jax.eval_shape(lambda: jax.random.key(0))
+    flat_tok = jax.ShapeDtypeStruct((num_slots,), jnp.int32)
+    dec_lowered = eng._decode_fn(bucket).lower(params, cache, flat_tok, rng)
+    n_cache = len(jax.tree.leaves(cache))
+    pairs = args_info_donations(dec_lowered)
+    if pairs is None:
+        # Old jax without args_info: count-level fallback only.
+        dons = [d.donated for d in lowered_donations(dec_lowered.as_text())]
+        if sum(dons) < n_cache:
+            report.add(
+                "donation", "error", "cache-not-donated",
+                f"serving decode step donates {sum(dons)} args but the "
+                f"cache alone has {n_cache} leaves — the engine holds two "
+                "caches live per step",
+                donated=sum(dons), cache_leaves=n_cache,
+            )
+        return report
+    # Per-path: every CACHE leaf specifically must be donated (a refactor
+    # donating params instead would pass a count-only gate). args_info
+    # paths root at (args, kwargs): cache is positional arg 1 → "[0][1]".
+    undonated_cache = [
+        p for p, d in pairs if p.startswith("[0][1]") and not d
+    ]
+    for p in undonated_cache:
+        report.add(
+            "donation", "error", "cache-not-donated",
+            f"serving decode step does not donate cache leaf {p} — the "
+            "engine holds two caches live per step",
+            path=p,
+        )
+    if not undonated_cache:
+        report.add(
+            "donation", "info", "summary",
+            f"decode step donates all {n_cache} cache leaves "
+            f"({sum(1 for _, d in pairs if d)}/{len(pairs)} args donated)",
+        )
+    return report
+
+
+def lint_hygiene(paths: Iterable[str] | None = None) -> Report:
+    """AST hygiene lint over the repo's traced modules."""
+    import glob
+    import os
+
+    from frl_distributed_ml_scaffold_tpu.analysis.hygiene import lint_file
+
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if paths is None:
+        paths = (
+            sorted(glob.glob(os.path.join(pkg, "ops", "*.py")))
+            + sorted(glob.glob(os.path.join(pkg, "parallel", "*.py")))
+            + sorted(glob.glob(os.path.join(pkg, "models", "*.py")))
+            + [os.path.join(pkg, "trainer", "train_step.py")]
+        )
+    report = Report(program="hygiene:traced-modules")
+    n = 0
+    for p in paths:
+        n += 1
+        report.extend(lint_file(p))
+    report.meta["files"] = n
+    return report
+
+
+def lint_all(
+    *,
+    recipes: Iterable[str] | None = None,
+    serving: bool = True,
+    hygiene: bool = True,
+    workdir: str = "/tmp/graft_lint",
+    budget_bytes: int | None = None,
+    on_report: Callable[[Report], None] | None = None,
+) -> list[Report]:
+    """Lint every registered recipe (or the named subset) + extras."""
+    from frl_distributed_ml_scaffold_tpu.config import list_configs
+
+    names = list(recipes) if recipes is not None else list_configs()
+    reports = []
+
+    def emit(r: Report) -> None:
+        reports.append(r)
+        if on_report is not None:
+            on_report(r)
+
+    for name in names:
+        try:
+            emit(lint_train_step(
+                name, workdir=workdir, budget_bytes=budget_bytes
+            ))
+        except Exception as e:  # surface as a finding, not a crash
+            r = Report(program=f"recipe:{name}")
+            r.add(
+                "runner", "error", "lint-crashed",
+                f"linting {name} raised {type(e).__name__}: {e}",
+            )
+            emit(r)
+    if serving:
+        emit(lint_decode_step())
+    if hygiene:
+        emit(lint_hygiene())
+    return reports

@@ -228,7 +228,15 @@ class ServingEngine:
                 logits, cache = _decode_step(m, params, cache, tok)
                 return _sample(logits, rng, **kw), cache
 
-            self._decode_jit[s] = jax.jit(fn)
+            # Donate the cache (the PR 5 graft-lint audit's find): the
+            # engine immediately rebinds self.cache to the step's output,
+            # so the input cache is dead the moment the call is issued —
+            # without donation every decode step transiently holds TWO
+            # full KV caches live (cache-in + cache-out), exactly the
+            # allocation spike continuous batching sizes its slot count
+            # against. Pinned by tests/test_serving.py donation pins via
+            # analysis.pins.assert_donated/assert_aliased.
+            self._decode_jit[s] = jax.jit(fn, donate_argnums=(1,))
         return self._decode_jit[s]
 
     def _graft_fn(self, s_p: int, s: int):
@@ -253,7 +261,10 @@ class ServingEngine:
 
                 return jax.tree.map(leaf, cache, slot_cache)
 
-            self._graft_jit[(s_p, s)] = jax.jit(fn)
+            # The engine cache is rebound to the graft's output too —
+            # donate it (same audit find as _decode_fn; the slot cache is
+            # NOT donated: its rows are read strided into the update).
+            self._graft_jit[(s_p, s)] = jax.jit(fn, donate_argnums=(0,))
         return self._graft_jit[(s_p, s)]
 
     def _grow_fn(self, s_old: int, s_new: int):

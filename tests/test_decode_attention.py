@@ -236,19 +236,9 @@ def _decode_step_jaxpr(model, params, cache_len):
     return jax.make_jaxpr(step)(params, cache, tokens)
 
 
-def _all_eqn_shapes(jaxpr, acc):
-    for eqn in jaxpr.eqns:
-        for v in eqn.outvars:
-            if hasattr(v.aval, "shape"):
-                acc.append(tuple(v.aval.shape))
-        for p in eqn.params.values():
-            ps = p if isinstance(p, (list, tuple)) else [p]
-            for u in ps:
-                if hasattr(u, "eqns"):
-                    _all_eqn_shapes(u, acc)
-                elif hasattr(u, "jaxpr") and hasattr(u.jaxpr, "eqns"):
-                    _all_eqn_shapes(u.jaxpr, acc)
-    return acc
+# The eqn-shape walker this file used to carry lives in
+# analysis/jaxpr_utils.py; the pin itself rides analysis.pins.
+from frl_distributed_ml_scaffold_tpu.analysis import pins
 
 
 @pytest.mark.fast
@@ -262,12 +252,12 @@ def test_decode_step_reads_only_active_bucket(gpt):
     model, params, _ = gpt
     seq_len, bucket = model.config.seq_len, 16
     jaxpr = _decode_step_jaxpr(model, params, bucket)
-    shapes = _all_eqn_shapes(jaxpr.jaxpr, [])
-    offenders = [s for s in shapes if seq_len in s]
-    assert not offenders, (
+    pins.assert_no_dim_materialized(
+        jaxpr, seq_len,
         f"decode step materializes full-context ({seq_len}) arrays with a "
-        f"{bucket}-bucket cache: {offenders}"
+        f"{bucket}-bucket cache",
     )
+    shapes = pins.eqn_output_shapes(jaxpr)
     h, hd = model.config.num_heads, model.config.hidden_dim // model.config.num_heads
     assert any(
         s[-3:] == (bucket, h, hd) or (bucket in s and h in s)

@@ -161,22 +161,11 @@ def test_overlap_remat_full_interaction(tmp_path):
 
 
 # --------------------------------------------------------------- blockwise
+# Jaxpr pins ride the shared analysis.pins API (docs/static_analysis.md);
+# the per-test _walk_jaxpr copy this file used to carry lives in
+# analysis/jaxpr_utils.py.
 
-
-def _walk_jaxpr(jaxpr, prim_name, found):
-    """Collect output shapes of every ``prim_name`` eqn, recursing into
-    sub-jaxprs (scan bodies, remat/custom_vjp calls, shard_map regions)."""
-    for eqn in jaxpr.eqns:
-        if prim_name in str(eqn.primitive):
-            found.append(tuple(v.aval.shape for v in eqn.outvars))
-        for v in eqn.params.values():
-            vs = v if isinstance(v, (list, tuple)) else [v]
-            for u in vs:
-                if hasattr(u, "eqns"):
-                    _walk_jaxpr(u, prim_name, found)
-                elif hasattr(u, "jaxpr") and hasattr(u.jaxpr, "eqns"):
-                    _walk_jaxpr(u.jaxpr, prim_name, found)
-    return found
+from frl_distributed_ml_scaffold_tpu.analysis import pins
 
 
 def test_overlap_gathers_are_blockwise(tmp_path):
@@ -194,36 +183,27 @@ def test_overlap_gathers_are_blockwise(tmp_path):
     with mesh_context(t.env):
         jaxpr = jax.make_jaxpr(t._train_step_fn)(state, batch)
 
-    gathers = _walk_jaxpr(jaxpr.jaxpr, "all_gather", [])
-    assert gathers, "overlap mode produced no explicit all_gather"
+    pins.assert_collective_present(
+        jaxpr, "all_gather", "overlap mode produced no explicit all_gather"
+    )
 
     stacked = {
         tuple(l.shape) for l in jax.tree.leaves(state.params["blocks"])
     }
     sliced = {s[1:] for s in stacked}
-    max_block_bytes = sum(
-        int(np.prod(s[1:])) * 4 for s in stacked
+    # Membership in the per-layer slice set is the whole pin: it excludes
+    # the stacked [L, ...] leaves (different rank) and bounds every
+    # gather's bytes at one block's worth.
+    pins.assert_all_gather_outputs_within(
+        jaxpr, sliced,
+        "an all_gather output is not a per-block param slice "
+        f"(expected one of {sorted(sliced)}) — the gather is NOT blockwise",
     )
-    for out_shapes in gathers:
-        for shape in out_shapes:
-            assert shape not in stacked, (
-                f"full stacked leaf {shape} passed through an all_gather — "
-                "the gather is NOT blockwise"
-            )
-            assert shape in sliced, (
-                f"all_gather output {shape} is not a per-block param slice "
-                f"(expected one of {sorted(sliced)})"
-            )
-            assert int(np.prod(shape)) * 4 <= max_block_bytes
 
     # The scan body must contain the gathers (that's what makes the
     # schedule per-iteration): at least one scan eqn exists whose body
     # carries all_gather eqns.
-    scans = []
-    for eqn in jaxpr.jaxpr.eqns:
-        if str(eqn.primitive) == "scan":
-            body_gathers = _walk_jaxpr(eqn.params["jaxpr"].jaxpr, "all_gather", [])
-            scans.append(len(body_gathers))
+    scans = pins.scan_collective_counts(jaxpr, "all_gather")
     assert any(n > 0 for n in scans), (
         "no scan body contains the explicit gathers — they were hoisted "
         f"out of the layer loop (scan gather counts: {scans})"
@@ -242,10 +222,10 @@ def test_overlap_backward_has_reduce_scatter(tmp_path):
     batch = t.pipeline.global_batch(0)
     with mesh_context(t.env):
         jaxpr = jax.make_jaxpr(t._train_step_fn)(state, batch)
-    scatters = _walk_jaxpr(jaxpr.jaxpr, "reduce_scatter", [])
-    assert scatters, (
+    pins.assert_collective_present(
+        jaxpr, "reduce_scatter",
         "no explicit reduce_scatter in the overlap step jaxpr — gradients "
-        "are not being scattered back into shards"
+        "are not being scattered back into shards",
     )
 
 

@@ -97,6 +97,26 @@ def test_generation_refuses_context_overflow(gpt):
         generate(model, params, tokens, max_new_tokens=17)  # 8 + 17 > 24
 
 
+def test_generate_bucketed_decode_never_materializes_full_context(gpt):
+    """The whole generate() program — prefill + scanned decode — under a
+    16-token cache bucket materializes NO array carrying the full
+    ``seq_len`` (the PR 4 decode pin, now via analysis.pins and extended
+    from the single decode step to the end-to-end sampling program; the
+    wpe param is an invar and exempt by construction)."""
+    from frl_distributed_ml_scaffold_tpu.analysis import pins
+
+    model, params, tokens = gpt
+    jaxpr = jax.make_jaxpr(
+        lambda p, t: generate(
+            model, p, t, max_new_tokens=6, temperature=0.0, cache_len=16
+        )
+    )(params, tokens)
+    pins.assert_no_dim_materialized(jaxpr, model.config.seq_len)
+    # And the bucket is actually in play (a cache-free rewrite would
+    # also pass the negative pin).
+    assert any(16 in s for s in pins.eqn_output_shapes(jaxpr))
+
+
 def test_eos_padding(gpt):
     """Once eos is emitted (forced here via vocab-restricted greedy), the
     remaining positions hold eos."""

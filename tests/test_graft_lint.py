@@ -1,0 +1,552 @@
+"""graft-lint (frl_distributed_ml_scaffold_tpu/analysis/): each analyzer
+pass on small synthetic programs — one positive and one negative case per
+pass — plus the mutation gates the ISSUE names: re-enable plain GSPMD TP
+and the exposed-collective detector fires; drop a donation and the audit
+fires; oversize a decode intermediate and the materialization budget
+fires.  The CLI itself runs over every registered recipe as the `lint`
+tier's integration gate."""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from frl_distributed_ml_scaffold_tpu.analysis import pins
+from frl_distributed_ml_scaffold_tpu.analysis.collectives import (
+    census_diff,
+    collective_census,
+    hlo_collective_census,
+)
+from frl_distributed_ml_scaffold_tpu.analysis.donation import (
+    args_info_donations,
+    compiled_aliases,
+    lowered_donations,
+)
+from frl_distributed_ml_scaffold_tpu.analysis.hygiene import lint_source
+from frl_distributed_ml_scaffold_tpu.analysis.materialization import (
+    max_materialized_bytes,
+    oversized_intermediates,
+)
+from frl_distributed_ml_scaffold_tpu.analysis.reshard import (
+    exposed_collectives,
+    monolithic_gathers,
+)
+from frl_distributed_ml_scaffold_tpu.dist.mesh import (
+    build_mesh,
+    mesh_context,
+    shard_map_compat,
+)
+from frl_distributed_ml_scaffold_tpu.config.schema import MeshConfig
+
+pytestmark = pytest.mark.lint
+
+
+# ------------------------------------------------------ collective census
+
+
+@pytest.mark.fast
+def test_census_counts_collectives_with_axes_and_scan_trips():
+    """Positive: a psum + ppermute inside a 3-trip scan is recorded with
+    its axis name and a trip_count of 3; negative: a collective-free
+    program yields an empty census."""
+    env = build_mesh(MeshConfig(data=8))
+
+    def inner(x):
+        def body(c, _):
+            c = jax.lax.psum(c, "data")
+            c = jax.lax.ppermute(
+                c, "data", [(i, (i + 1) % 8) for i in range(8)]
+            )
+            return c, ()
+
+        y, _ = jax.lax.scan(body, x, None, length=3)
+        return y
+
+    f = shard_map_compat(
+        inner, mesh=env.mesh, in_specs=P("data"), out_specs=P("data")
+    )
+    with mesh_context(env):
+        jaxpr = jax.make_jaxpr(f)(jnp.ones((8, 4)))
+    census = collective_census(jaxpr)
+    by_prim = {r.primitive: r for r in census}
+    assert set(by_prim) == {"psum", "ppermute"}, census
+    assert by_prim["psum"].axes == ("data",)
+    assert by_prim["psum"].trip_count == 3
+    assert by_prim["ppermute"].trip_count == 3
+    # bytes: per-shard [1, 4] fp32 = 16 bytes per call (8-way split of 8).
+    assert by_prim["psum"].bytes_per_call == 1 * 4 * 4
+    assert by_prim["psum"].total_bytes == 3 * 1 * 4 * 4
+
+    empty = collective_census(jax.make_jaxpr(lambda x: x * 2)(jnp.ones(3)))
+    assert empty == []
+
+
+@pytest.mark.fast
+def test_census_diff_reports_added_and_removed():
+    env = build_mesh(MeshConfig(data=8))
+
+    def with_psum(x):
+        return jax.lax.psum(x, "data")
+
+    def with_two(x):
+        return jax.lax.psum(jax.lax.psum(x, "data"), "data")
+
+    def mk(fn):
+        f = shard_map_compat(
+            fn, mesh=env.mesh, in_specs=P("data"), out_specs=P()
+        )
+        with mesh_context(env):
+            return collective_census(jax.make_jaxpr(f)(jnp.ones((8,))))
+
+    one, two = mk(with_psum), mk(with_two)
+    d = census_diff(one, two)
+    assert len(d["added"]) == 1 and d["added"][0]["count"] == 1
+    assert d["removed"] == []
+    d_rev = census_diff(two, one)
+    assert len(d_rev["removed"]) == 1 and d_rev["added"] == []
+    assert census_diff(one, one) == {"added": [], "removed": []}
+
+
+@pytest.mark.fast
+def test_census_diff_sees_scan_trip_count_drift():
+    """Same eqn, longer scan (12x the wire bytes) must register as drift
+    — trip_count is part of the record identity."""
+    env = build_mesh(MeshConfig(data=8))
+
+    def mk(length):
+        def inner(x):
+            def body(c, _):
+                return jax.lax.psum(c, "data"), ()
+
+            return jax.lax.scan(body, x, None, length=length)[0]
+
+        f = shard_map_compat(
+            inner, mesh=env.mesh, in_specs=P("data"), out_specs=P("data")
+        )
+        with mesh_context(env):
+            return collective_census(jax.make_jaxpr(f)(jnp.ones((8, 4))))
+
+    d = census_diff(mk(2), mk(24))
+    assert d["added"] and d["removed"], d
+    assert d["added"][0]["trip_count"] == 24
+    assert d["removed"][0]["trip_count"] == 2
+
+
+# --------------------------------------- exposed collectives / reshard
+
+
+def _tp_matmul_compiled(constrain_out: bool):
+    """A Megatron-ish sharded matmul pair; GSPMD must insert an all-reduce
+    (row-split contraction) when the output is pinned replicated-on-model."""
+    env = build_mesh(MeshConfig(data=2, model=4))
+    mesh = env.mesh
+    x = jax.ShapeDtypeStruct(
+        (16, 32), jnp.float32, sharding=NamedSharding(mesh, P("data", None))
+    )
+    w1 = jax.ShapeDtypeStruct(
+        (32, 32), jnp.float32, sharding=NamedSharding(mesh, P(None, "model"))
+    )
+    w2 = jax.ShapeDtypeStruct(
+        (32, 32), jnp.float32, sharding=NamedSharding(mesh, P("model", None))
+    )
+
+    def f(x, w1, w2):
+        y = (x @ w1) @ w2
+        if constrain_out:
+            y = jax.lax.with_sharding_constraint(
+                y, NamedSharding(mesh, P("data", None))
+            )
+        return y
+
+    with mesh_context(env):
+        return jax.jit(f).lower(x, w1, w2).compile()
+
+
+@pytest.mark.fast
+def test_mutation_gspmd_tp_trips_exposed_collective_detector():
+    """THE mutation gate: on plain GSPMD TP the partitioner inserts an
+    all-reduce for the row-split contraction — the detector must fire on
+    the compiled HLO (it cannot fire on the jaxpr: GSPMD collectives
+    don't exist there, which is why the detector reads HLO)."""
+    compiled = _tp_matmul_compiled(constrain_out=True)
+    assert collective_census(
+        jax.make_jaxpr(lambda x: x + 1)(jnp.ones(3))
+    ) == []  # jaxpr level blind to GSPMD, as documented
+    hits = exposed_collectives(
+        compiled.as_text(), ops=("all-reduce", "all-gather")
+    )
+    assert hits, "GSPMD TP produced no exposed collective?!"
+    with pytest.raises(AssertionError, match="all-reduce"):
+        pins.assert_no_collective_hlo(compiled, "all-reduce")
+
+
+@pytest.mark.fast
+def test_negative_unsharded_program_has_no_exposed_collectives():
+    compiled = jax.jit(lambda x: x @ x).lower(jnp.ones((8, 8))).compile()
+    assert exposed_collectives(compiled.as_text()) == []
+    pins.assert_no_collective_hlo(compiled, "all-reduce")
+    pins.assert_no_collective_hlo(compiled, "all-gather")
+
+
+@pytest.mark.fast
+def test_monolithic_gather_detector_on_synthetic_gathers():
+    """Positive/negative for the jaxpr-level reshard pass: a gather of an
+    allowed per-block slice passes; a gather of a full stacked tensor is
+    flagged."""
+    env = build_mesh(MeshConfig(fsdp=8))
+
+    def gather(x):
+        return jax.lax.all_gather(x, "fsdp", tiled=True)
+
+    f = shard_map_compat(
+        gather, mesh=env.mesh, in_specs=P("fsdp"), out_specs=P()
+    )
+    with mesh_context(env):
+        jaxpr = jax.make_jaxpr(f)(jnp.ones((8, 16)))
+    assert monolithic_gathers(jaxpr, allowed_shapes={(8, 16)}) == []
+    bad = monolithic_gathers(jaxpr, allowed_shapes={(2, 16)})
+    assert bad == [(8, 16)]
+    pins.assert_all_gather_outputs_within(jaxpr, {(8, 16)})
+    with pytest.raises(AssertionError, match="monolithic"):
+        pins.assert_all_gather_outputs_within(jaxpr, {(2, 16)})
+
+
+@pytest.mark.fast
+def test_reshard_pin_matches_shape_signatures_in_hlo():
+    """assert_reshard_free flags only collectives carrying the pinned
+    signatures (the serving handoff pin's contract)."""
+    compiled = _tp_matmul_compiled(constrain_out=True)
+    txt = compiled.as_text()
+    hits = hlo_collective_census(txt)
+    assert hits
+    shapes = {tuple(s) for r in hits for s in r.shapes}
+    some_shape = next(iter(shapes))
+    with pytest.raises(AssertionError, match="reshard"):
+        pins.assert_reshard_free(
+            txt, [some_shape],
+            ops=("all-reduce", "all-gather", "all-to-all"),
+        )
+    # A signature that matches nothing passes.
+    pins.assert_reshard_free(txt, [(99, 99, 99)])
+
+
+# ------------------------------------------------------- materialization
+
+
+@pytest.mark.fast
+def test_materialization_budget_positive_and_negative():
+    def f(x):
+        big = jnp.einsum("i,j->ij", x, x)  # [256, 256] fp32 = 256 KiB
+        return big.sum()
+
+    jaxpr = jax.make_jaxpr(f)(jnp.ones((256,)))
+    assert max_materialized_bytes(jaxpr) == 256 * 256 * 4
+    assert oversized_intermediates(jaxpr, 300 * 1024) == []
+    over = oversized_intermediates(jaxpr, 100 * 1024)
+    assert [tuple(i.shape) for i in over] == [(256, 256)]
+    pins.assert_max_materialized_bytes(jaxpr, 300 * 1024)
+    with pytest.raises(AssertionError, match="budget"):
+        pins.assert_max_materialized_bytes(jaxpr, 100 * 1024)
+
+
+@pytest.mark.fast
+def test_mutation_oversized_decode_intermediate_is_caught(gpt_tiny):
+    """THE decode mutation gate: the bucketed decode step passes the
+    no-full-seq_len pin; the legacy full-context cache (the 'oversized
+    intermediate' mutation — cache_len=seq_len) trips the same analyzer."""
+    model, params = gpt_tiny
+    seq_len = model.config.seq_len
+
+    def step_jaxpr(cache_len):
+        m = model.clone(cache_len=cache_len)
+        tokens = jnp.zeros((2, 1), jnp.int32)
+        _, vo = jax.eval_shape(
+            lambda p, t: m.apply(
+                {"params": p}, t, decode=True, mutable=["cache"]
+            ),
+            params, tokens,
+        )
+        return jax.make_jaxpr(
+            lambda p, c, t: m.apply(
+                {"params": p, "cache": c}, t, decode=True,
+                mutable=["cache"],
+            )
+        )(params, vo["cache"], tokens)
+
+    pins.assert_no_dim_materialized(step_jaxpr(16), seq_len)
+    with pytest.raises(AssertionError, match=str(seq_len)):
+        pins.assert_no_dim_materialized(step_jaxpr(seq_len), seq_len)
+
+
+@pytest.fixture(scope="module")
+def gpt_tiny():
+    from frl_distributed_ml_scaffold_tpu.config.schema import (
+        GPTConfig,
+        PrecisionConfig,
+    )
+    from frl_distributed_ml_scaffold_tpu.models.gpt import GPT
+    from frl_distributed_ml_scaffold_tpu.precision import get_policy
+
+    model = GPT(
+        GPTConfig(
+            vocab_size=64, num_layers=2, num_heads=2, hidden_dim=32,
+            seq_len=96, dropout=0.0,
+        ),
+        get_policy(PrecisionConfig(policy="fp32")),
+    )
+    params = jax.eval_shape(
+        lambda: model.init(
+            {"params": jax.random.key(0)},
+            jnp.zeros((2, 4), jnp.int32),
+            train=False,
+        )["params"]
+    )
+    return model, params
+
+
+# --------------------------------------------------------------- donation
+
+
+@pytest.mark.fast
+def test_mutation_dropped_donation_is_caught():
+    """THE donation mutation gate: the same program jitted with and
+    without donate_argnums — the audit passes the donated one and fires
+    on the dropped one, at both the lowered and args_info levels."""
+    s = {"mu": jnp.ones((64, 64)), "nu": jnp.ones((64, 64))}
+    g = jnp.ones((64, 64))
+
+    def update(s, g):
+        return {"mu": s["mu"] * 0.9 + g, "nu": s["nu"] * 0.99 + g * g}
+
+    donated = jax.jit(update, donate_argnums=(0,)).lower(s, g)
+    dropped = jax.jit(update).lower(s, g)
+
+    pins.assert_donated(donated, min_donated=2)
+    with pytest.raises(AssertionError, match="donated"):
+        pins.assert_donated(dropped, min_donated=1)
+
+    d_pairs = dict(args_info_donations(donated))
+    assert all(d for p, d in d_pairs.items() if "mu" in p or "nu" in p)
+    assert not any(d for p, d in dict(args_info_donations(dropped)).items())
+
+    # Lowered-marker level agrees.
+    assert sum(1 for d in lowered_donations(donated.as_text()) if d.donated) == 2
+    assert sum(1 for d in lowered_donations(dropped.as_text()) if d.donated) == 0
+
+
+@pytest.mark.fast
+def test_compiled_alias_table_positive_and_negative():
+    """Compiled-executable ground truth: donation shows up in
+    input_output_alias; without donation the table is empty."""
+    f = lambda x: x + 1.0
+    x = jnp.ones((32, 32))
+    comp_d = jax.jit(f, donate_argnums=(0,)).lower(x).compile()
+    comp_n = jax.jit(f).lower(x).compile()
+    aliases = pins.assert_aliased(comp_d, min_aliases=1)
+    assert aliases[0]["param"] == 0
+    assert compiled_aliases(comp_n) == []
+    with pytest.raises(AssertionError, match="alias"):
+        pins.assert_aliased(comp_n)
+
+
+# ---------------------------------------------------------------- hygiene
+
+
+@pytest.mark.fast
+def test_hygiene_flags_host_sync_rng_and_axis_typo():
+    src = '''
+import random
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+def traced_bad(x):
+    noise = random.random()
+    y = jnp.sum(x) * noise
+    z = float(np.median(y))
+    zz = float(jnp.mean(y))
+    v = y.item()
+    w = lax.psum(y, "modle")
+    i = lax.axis_index("daat")
+    return jax.device_get(w) + i
+
+def host_ok(batch):
+    import numpy as np
+    return np.asarray(batch["x"]).mean()
+'''
+    findings = lint_source(src, "synthetic.py")
+    codes = sorted({(f.code, f.severity) for f in findings})
+    assert ("python-rng", "error") in codes, codes
+    assert ("host-sync", "error") in codes, codes
+    assert ("axis-typo", "error") in codes, codes
+    # Both positions: psum's arg 1 ("modle") AND axis_index's arg 0
+    # ("daat") — the typo detector knows each collective's axis slot.
+    typos = {f.context["axis"] for f in findings if f.code == "axis-typo"}
+    assert typos == {"modle", "daat"}, typos
+    assert ("host-sync-cast", "warning") in codes, codes  # float(np.median)
+    assert ("numpy-in-traced", "warning") in codes, codes
+    # The host-side function (no jnp/lax in body) is exempt.
+    assert not any(
+        f.context.get("function") == "host_ok" for f in findings
+    ), findings
+
+
+@pytest.mark.fast
+def test_hygiene_clean_traced_source_passes():
+    src = '''
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+def traced_ok(x):
+    y = jnp.sum(x)
+    return lax.psum(y, "model")
+'''
+    assert lint_source(src, "clean.py") == []
+
+
+@pytest.mark.fast
+def test_hygiene_repo_traced_modules_are_clean():
+    """The repo's own traced modules carry no hygiene errors (warnings
+    allowed: shape-time numpy is legal)."""
+    from frl_distributed_ml_scaffold_tpu.analysis.runner import lint_hygiene
+
+    report = lint_hygiene()
+    assert report.errors() == [], [f.message for f in report.errors()]
+
+
+# ------------------------------------------------------------ runner/CLI
+
+
+@pytest.mark.fast
+def test_lint_train_step_overlap_recipes_enforce_their_pins():
+    """The runner applies the right invariant per recipe class: both
+    overlap recipes lint clean at HEAD (their schedules intact)."""
+    from frl_distributed_ml_scaffold_tpu.analysis.runner import (
+        lint_train_step,
+    )
+
+    for name in ("gpt2_medium_tp_overlap", "gpt2_medium_fsdp_overlap"):
+        rep = lint_train_step(name, workdir="/tmp/graft_lint_test")
+        assert rep.ok, [f.message for f in rep.errors()]
+        census = rep.meta["collective_census"]
+        assert census, "overlap recipe census is empty?!"
+        prims = {r["primitive"] for r in census}
+        if name == "gpt2_medium_tp_overlap":
+            assert "ppermute" in prims, prims
+            assert "all_gather" not in prims, prims
+        else:
+            assert "all_gather" in prims and "reduce_scatter" in prims, prims
+
+
+@pytest.mark.fast
+def test_lint_runner_unknown_recipe_refuses():
+    from frl_distributed_ml_scaffold_tpu.analysis.runner import (
+        lint_train_step,
+    )
+
+    with pytest.raises(KeyError, match="RECIPE_OVERRIDES"):
+        lint_train_step("no_such_recipe", workdir="/tmp/graft_lint_test")
+
+
+@pytest.mark.fast
+def test_cli_all_recipes_runs_clean_and_emits_json(tmp_path):
+    """The acceptance gate: `python tools/graft_lint.py --all-recipes`
+    exits 0 on HEAD under JAX_PLATFORMS=cpu and the JSON report covers
+    every registered recipe + the serving decode step + hygiene."""
+    from frl_distributed_ml_scaffold_tpu.config import list_configs
+
+    out = tmp_path / "report.json"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "graft_lint.py"),
+         "--all-recipes", "--json", str(out), "-q",
+         "--workdir", str(tmp_path / "wd")],
+        capture_output=True, text=True, env=env, cwd=repo, timeout=540,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    reports = json.loads(out.read_text())
+    programs = {r["program"] for r in reports}
+    for name in list_configs():
+        assert f"recipe:{name}" in programs, programs
+    assert "serving:decode_step" in programs
+    assert "hygiene:traced-modules" in programs
+    assert all(r["ok"] for r in reports), [
+        r["program"] for r in reports if not r["ok"]
+    ]
+
+
+@pytest.mark.fast
+def test_cli_exits_nonzero_on_error_finding(tmp_path, monkeypatch):
+    """severity:error ⇒ non-zero exit: lint a recipe subset with an
+    absurd materialization budget (1 byte) — every recipe trips it."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "graft_lint.py"),
+         "--recipe", "mnist_mlp", "--no-serving", "--no-hygiene",
+         "--budget-mb", "0.000001", "-q",
+         "--workdir", str(tmp_path / "wd")],
+        capture_output=True, text=True, env=env, cwd=repo, timeout=300,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "over-budget" in proc.stdout
+
+
+def test_cli_census_baseline_roundtrip_and_diff(tmp_path):
+    """--save-census then --against: identical program ⇒ no census
+    warnings; a doctored baseline (one ring removed) ⇒ census-added."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    census_path = tmp_path / "census.json"
+    base_cmd = [
+        sys.executable, os.path.join(repo, "tools", "graft_lint.py"),
+        "--recipe", "gpt2_medium_tp_overlap", "--no-serving",
+        "--no-hygiene", "-q", "--workdir", str(tmp_path / "wd"),
+    ]
+    proc = subprocess.run(
+        base_cmd + ["--save-census", str(census_path)],
+        capture_output=True, text=True, env=env, cwd=repo, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    baseline = json.loads(census_path.read_text())
+    assert baseline["recipe:gpt2_medium_tp_overlap"]
+
+    proc2 = subprocess.run(
+        base_cmd + ["--against", str(census_path), "--json",
+                    str(tmp_path / "r.json")],
+        capture_output=True, text=True, env=env, cwd=repo, timeout=300,
+    )
+    assert proc2.returncode == 0
+    reports = json.loads((tmp_path / "r.json").read_text())
+    assert not any(
+        f["code"].startswith("census-")
+        for r in reports for f in r["findings"]
+    )
+
+    # Doctor the baseline: drop one record — the diff must flag it added.
+    key = "recipe:gpt2_medium_tp_overlap"
+    baseline[key] = baseline[key][1:]
+    census_path.write_text(json.dumps(baseline))
+    proc3 = subprocess.run(
+        base_cmd + ["--against", str(census_path), "--json",
+                    str(tmp_path / "r3.json")],
+        capture_output=True, text=True, env=env, cwd=repo, timeout=300,
+    )
+    assert proc3.returncode == 0  # census drift is a warning, not an error
+    reports3 = json.loads((tmp_path / "r3.json").read_text())
+    assert any(
+        f["code"] == "census-added"
+        for r in reports3 for f in r["findings"]
+    ), reports3

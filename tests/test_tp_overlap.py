@@ -197,22 +197,11 @@ def test_vit_tp_overlap_matches(tmp_path):
 
 
 # --------------------------------------------------------------- blockwise
+# Jaxpr pins ride the shared analysis.pins API (docs/static_analysis.md);
+# the per-test _walk_jaxpr copies this file used to carry live in
+# analysis/jaxpr_utils.py.
 
-
-def _walk_jaxpr(jaxpr, prim_name, found):
-    """Collect output shapes of every ``prim_name`` eqn, recursing into
-    sub-jaxprs (scan bodies, remat/custom_vjp calls, shard_map regions)."""
-    for eqn in jaxpr.eqns:
-        if prim_name in str(eqn.primitive):
-            found.append(tuple(v.aval.shape for v in eqn.outvars))
-        for v in eqn.params.values():
-            vs = v if isinstance(v, (list, tuple)) else [v]
-            for u in vs:
-                if hasattr(u, "eqns"):
-                    _walk_jaxpr(u, prim_name, found)
-                elif hasattr(u, "jaxpr") and hasattr(u.jaxpr, "eqns"):
-                    _walk_jaxpr(u.jaxpr, prim_name, found)
-    return found
+from frl_distributed_ml_scaffold_tpu.analysis import pins
 
 
 def _step_jaxpr(t):
@@ -243,23 +232,20 @@ def test_tp_overlap_schedule_is_blockwise_ppermute(tmp_path, policy):
     )
     jaxpr, _ = _step_jaxpr(t)
 
-    assert not _walk_jaxpr(jaxpr.jaxpr, "all_gather", []), (
+    pins.assert_no_collective(
+        jaxpr, "all_gather",
         "tp_overlap step contains an explicit all_gather — the activation "
-        "gather is supposed to be a blockwise ppermute ring"
+        "gather is supposed to be a blockwise ppermute ring",
     )
-    total = _walk_jaxpr(jaxpr.jaxpr, "ppermute", [])
-    assert total, "tp_overlap produced no ppermute chains"
+    pins.assert_collective_present(
+        jaxpr, "ppermute", "tp_overlap produced no ppermute chains"
+    )
 
     # Per layer-scan iteration: 4 rings (shared-QKV gather, fc_in gather,
     # attn-out scatter, fc_out scatter), each a bidirectional chain of
     # 2*(m-1) hops. The scan bodies must carry them — that's what makes
     # the schedule per-block; the backward scan carries its own.
-    scan_counts = []
-    for eqn in jaxpr.jaxpr.eqns:
-        if str(eqn.primitive) == "scan":
-            scan_counts.append(
-                len(_walk_jaxpr(eqn.params["jaxpr"].jaxpr, "ppermute", []))
-            )
+    scan_counts = pins.scan_collective_counts(jaxpr, "ppermute")
     with_rings = [n for n in scan_counts if n > 0]
     assert len(with_rings) >= 2, (
         "expected ppermute chains inside both the forward and backward "
@@ -285,8 +271,10 @@ def test_tp_overlap_no_activation_gather_under_fsdp(tmp_path):
         tmp_path,
     )
     jaxpr, state = _step_jaxpr(t)
-    gathers = _walk_jaxpr(jaxpr.jaxpr, "all_gather", [])
-    assert gathers, "fsdp_overlap composition lost its explicit param gathers"
+    pins.assert_collective_present(
+        jaxpr, "all_gather",
+        "fsdp_overlap composition lost its explicit param gathers",
+    )
     # The param gathers run inside shard_map, so their jaxpr-level output
     # shapes are per-shard views: a per-block slice with its Megatron-split
     # dim still divided by the model axis.
@@ -298,14 +286,13 @@ def test_tp_overlap_no_activation_gather_under_fsdp(tmp_path):
         for i, d in enumerate(s):
             if d % m == 0:
                 param_slices.add(s[:i] + (d // m,) + s[i + 1 :])
-    for out_shapes in gathers:
-        for shape in out_shapes:
-            assert shape in param_slices, (
-                f"all_gather output {shape} is not a per-block param slice "
-                "— an activation passed through a monolithic gather"
-            )
-    assert _walk_jaxpr(jaxpr.jaxpr, "ppermute", []), (
-        "composed schedule lost its ppermute rings"
+    pins.assert_all_gather_outputs_within(
+        jaxpr, param_slices,
+        "an all_gather output is not a per-block param slice — an "
+        "activation passed through a monolithic gather",
+    )
+    pins.assert_collective_present(
+        jaxpr, "ppermute", "composed schedule lost its ppermute rings"
     )
 
 
