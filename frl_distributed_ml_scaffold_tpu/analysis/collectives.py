@@ -139,6 +139,27 @@ def census_summary(records: list[CollectiveRecord]) -> dict[str, Any]:
     return agg
 
 
+def census_by_dtype(
+    records: list[CollectiveRecord],
+) -> dict[tuple[str, str], dict[str, int]]:
+    """Aggregate census keyed ``(primitive, element dtype)`` — the view
+    the low-precision fast path is pinned through: an int8 collective-
+    matmul ring shows its wire bytes under ``("ppermute", "int8")`` with
+    only scalar scales left under the wide-float dtypes, and a silent
+    fall-back to bf16/fp32 payloads moves the bytes back where
+    ``assert_collective_bytes_within`` (analysis/pins.py) and the
+    graft-lint wide-ppermute check will refuse them."""
+    agg: dict[tuple[str, str], dict[str, int]] = {}
+    for r in records:
+        a = agg.setdefault(
+            (r.primitive, r.dtype), {"eqns": 0, "calls": 0, "total_bytes": 0}
+        )
+        a["eqns"] += 1
+        a["calls"] += r.trip_count
+        a["total_bytes"] += r.total_bytes
+    return agg
+
+
 def census_diff(
     old: list[CollectiveRecord], new: list[CollectiveRecord]
 ) -> dict[str, list[dict[str, Any]]]:

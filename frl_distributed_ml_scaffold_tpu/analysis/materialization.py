@@ -78,6 +78,42 @@ def intermediates_with_dim(jaxpr: Any, dim: int) -> list[Intermediate]:
     return [i for i in intermediates(jaxpr) if dim in i.shape]
 
 
+def _itemsize(i: Intermediate) -> int:
+    """Element width recovered from the byte census itself (no dtype-
+    string parsing: ``bytes / numel`` is already exact)."""
+    import numpy as np
+
+    n = int(np.prod(i.shape, dtype=np.int64)) if i.shape else 1
+    return i.bytes // max(n, 1)
+
+
+def wide_intermediates_with_dims(
+    jaxpr: Any, dims: tuple[int, ...], *, min_itemsize: int = 2
+) -> list[Intermediate]:
+    """Float intermediates of element width >= ``min_itemsize`` whose
+    shape contains every dim of ``dims`` (with multiplicity, in ANY
+    order — a layout transpose must not dodge the pin) — the quantized-
+    cache pin's detector: with an int8 KV cache of geometry
+    ``(S, H, hd)``, a decode step materializing a wide-float array
+    carrying all three dims has dequantized the whole cache, whether in
+    the storage layout ``[B, S, H, hd]`` or the kernel's transposed
+    ``[B, H, S, hd]`` (the exact allocation the quantized cache exists
+    to avoid; its 1-byte cache updates and its small per-chunk/per-scale
+    floats all lack the full ``S`` dim and pass)."""
+    from collections import Counter
+
+    need = Counter(dims)
+    out = []
+    for i in intermediates(jaxpr):
+        if not i.dtype.startswith(("float", "bfloat")):
+            continue
+        if _itemsize(i) < min_itemsize:
+            continue
+        if not need - Counter(i.shape):
+            out.append(i)
+    return out
+
+
 def materialization_findings(
     jaxpr: Any,
     *,

@@ -16,6 +16,10 @@ CLI runs.  The pre-existing ad-hoc pins map as:
     → ``assert_reshard_free``.
 - PR 5 "state/cache donated and actually aliased"
     → ``assert_donated`` / ``assert_aliased``.
+- PR 6 "int8 rings actually shrink the wire; no decode step dequantizes
+  the whole cache"
+    → ``assert_collective_bytes_within`` (per-dtype collective bytes)
+      / ``assert_no_wide_dims_materialized``.
 """
 
 from __future__ import annotations
@@ -23,6 +27,7 @@ from __future__ import annotations
 from typing import Any, Callable, Iterable, Sequence
 
 from frl_distributed_ml_scaffold_tpu.analysis.collectives import (
+    CollectiveRecord,
     collective_census,
     hlo_collective_census,
 )
@@ -39,6 +44,7 @@ from frl_distributed_ml_scaffold_tpu.analysis.materialization import (
     intermediates_with_dim,
     max_materialized_bytes,
     oversized_intermediates,
+    wide_intermediates_with_dims,
 )
 from frl_distributed_ml_scaffold_tpu.analysis.reshard import (
     monolithic_gathers,
@@ -47,14 +53,17 @@ from frl_distributed_ml_scaffold_tpu.analysis.reshard import (
 
 __all__ = [
     "collective_census",
+    "collective_bytes",
     "eqn_output_shapes",
     "primitive_shapes",
     "scan_collective_counts",
     "assert_no_collective",
     "assert_collective_present",
+    "assert_collective_bytes_within",
     "assert_all_gather_outputs_within",
     "assert_max_materialized_bytes",
     "assert_no_dim_materialized",
+    "assert_no_wide_dims_materialized",
     "assert_donated",
     "assert_aliased",
     "assert_reshard_free",
@@ -107,6 +116,71 @@ def assert_collective_present(
     return found
 
 
+def _census_of(jaxpr_or_records: Any) -> list[CollectiveRecord]:
+    """Accept a (Closed)Jaxpr or an already-computed census."""
+    if isinstance(jaxpr_or_records, (list, tuple)) and (
+        not jaxpr_or_records
+        or isinstance(jaxpr_or_records[0], CollectiveRecord)
+    ):
+        return list(jaxpr_or_records)
+    return collective_census(jaxpr_or_records)
+
+
+def collective_bytes(
+    jaxpr_or_records: Any,
+    prim_name: str,
+    *,
+    dtypes: Iterable[str] | None = None,
+    axes: Iterable[str] | None = None,
+) -> int:
+    """Total per-step wire bytes (``bytes_per_call x trip_count``) of the
+    collectives whose primitive name contains ``prim_name``, optionally
+    restricted to element ``dtypes`` and/or to eqns naming one of
+    ``axes`` — the measurement half of the low-precision comm pin."""
+    dt = set(dtypes) if dtypes is not None else None
+    ax = set(axes) if axes is not None else None
+    total = 0
+    for r in _census_of(jaxpr_or_records):
+        if prim_name not in r.primitive:
+            continue
+        if dt is not None and r.dtype not in dt:
+            continue
+        if ax is not None and not (ax & set(r.axes)):
+            continue
+        total += r.total_bytes
+    return total
+
+
+def assert_collective_bytes_within(
+    jaxpr_or_records: Any,
+    prim_name: str,
+    budget_bytes: int,
+    *,
+    dtypes: Iterable[str] | None = None,
+    axes: Iterable[str] | None = None,
+    msg: str | None = None,
+) -> int:
+    """The matching per-step wire bytes stay <= ``budget_bytes``.
+
+    The low-precision fast path's comm reduction as a pinned invariant
+    (ISSUE 6): e.g. "wide-float ppermute bytes on the model axis fit in
+    the scale-traffic budget" — if a ring silently falls back to bf16
+    payloads, the bytes land outside the filter's budget and this fires
+    with the measured total. Returns the measured bytes for reporting.
+    """
+    total = collective_bytes(
+        jaxpr_or_records, prim_name, dtypes=dtypes, axes=axes
+    )
+    assert total <= budget_bytes, _fail(
+        msg,
+        f"{prim_name!r} collectives move {total} bytes/step"
+        + (f" in dtypes {sorted(dtypes)}" if dtypes is not None else "")
+        + (f" on axes {sorted(axes)}" if axes is not None else "")
+        + f", over the pinned budget of {budget_bytes} bytes",
+    )
+    return total
+
+
 def assert_all_gather_outputs_within(
     jaxpr: Any,
     allowed_shapes: Iterable[tuple[int, ...]],
@@ -152,6 +226,32 @@ def assert_no_dim_materialized(
         msg,
         f"program materializes arrays carrying forbidden dim {dim}: "
         + str(sorted({i.shape for i in hits})),
+    )
+
+
+def assert_no_wide_dims_materialized(
+    jaxpr: Any,
+    dims: tuple[int, ...],
+    *,
+    min_itemsize: int = 2,
+    msg: str | None = None,
+) -> None:
+    """No float intermediate of element width >= ``min_itemsize`` carries
+    every dim of ``dims`` (with multiplicity, in any order — a layout
+    transpose must not dodge the pin) — the quantized-KV pin: pass the
+    cache geometry ``(bucket, H, hd)`` and a decode step that
+    dequantizes the WHOLE cache (instead of per chunk in VMEM) fires,
+    in the storage layout or the kernel's transposed one, while the
+    1-byte cache updates, bounded dequantized chunks, and scale tensors
+    all lack the full ``bucket`` dim and pass."""
+    hits = wide_intermediates_with_dims(
+        jaxpr, dims, min_itemsize=min_itemsize
+    )
+    assert not hits, _fail(
+        msg,
+        f"program materializes wide (>= {min_itemsize}-byte) float arrays "
+        f"carrying the forbidden geometry {tuple(dims)}: "
+        + str(sorted({(i.dtype, i.shape) for i in hits})),
     )
 
 

@@ -29,7 +29,10 @@ from __future__ import annotations
 
 from typing import Any, Callable, Iterable
 
+import numpy as np
+
 from frl_distributed_ml_scaffold_tpu.analysis.collectives import (
+    census_by_dtype,
     census_summary,
     collective_census,
 )
@@ -50,6 +53,7 @@ from frl_distributed_ml_scaffold_tpu.analysis.pins import (
 from frl_distributed_ml_scaffold_tpu.analysis.reshard import (
     monolithic_gather_findings,
 )
+from frl_distributed_ml_scaffold_tpu.ops.quantization import lowp_dtype
 
 _COMMON = [
     "precision.policy=fp32",
@@ -92,6 +96,12 @@ _PP_TINY = [
     "trainer.grad_accum=1",
 ]
 
+#: Wide-dtype ppermute payloads at or under this many bytes/call are
+#: quantization SCALES (a per-chunk scalar, f32 <= 4 bytes; kept generous
+#: for per-row scale vectors), not chunk traffic — the carve-out the
+#: wide-ppermute error and the pinned bytes budgets share.
+_SCALE_BYTES_PER_CALL = 256
+
 # CPU-sim (8 virtual devices) shrink overrides per registered recipe —
 # the test_recipes.py discipline, centralized. A NEW recipe must either
 # inherit a family entry below or add its own; ``lint_recipe`` raises on
@@ -112,6 +122,8 @@ RECIPE_OVERRIDES: dict[str, list[str]] = {
     "gpt2_medium_fsdp_overlap": _GPT_TINY
     + ["mesh.fsdp=8", "parallel.fsdp_min_size=16"],
     "gpt2_medium_tp_overlap": _GPT_TINY
+    + ["mesh.data=1", "mesh.model=8"],
+    "gpt2_medium_tp_overlap_int8": _GPT_TINY
     + ["mesh.data=1", "mesh.model=8"],
     "gpt2_tp": _GPT_TINY + ["mesh.data=4", "mesh.model=2"],
     "gpt2_ring": [
@@ -248,6 +260,44 @@ def lint_train_step(
                 "reshard", "error", "missing-rings",
                 "tp_overlap step carries no ppermute rings",
             )
+    lp = getattr(cfg.parallel, "low_precision", "none")
+    if cfg.parallel.tp_overlap and lp != "none":
+        # The low-precision bytes pin (ISSUE 6): under a quantized recipe
+        # every ppermute payload must be 1-byte; the only wide-dtype
+        # ppermute traffic allowed is the scalar scales riding next to
+        # the chunks. A ring that silently falls back to bf16/fp32
+        # payloads moves chunk-sized wide transfers — error per eqn.
+        want = str(np.dtype(lowp_dtype(lp)))
+        for (prim, dtype), agg in sorted(census_by_dtype(census).items()):
+            if prim != "ppermute":
+                continue
+            report.add(
+                "collective_census", "info", "census-by-dtype",
+                f"ppermute[{dtype}]: {agg['eqns']} eqn(s), "
+                f"{agg['calls']} call(s)/step, {agg['total_bytes']} bytes",
+                primitive=prim, dtype=dtype, **agg,
+            )
+        wide = [
+            r for r in census
+            if r.primitive == "ppermute" and r.dtype != want
+            and r.bytes_per_call > _SCALE_BYTES_PER_CALL
+        ]
+        for r in wide:
+            report.add(
+                "collective_census", "error", "wide-ppermute",
+                f"{name}: low_precision={lp} ring ppermutes a "
+                f"{r.dtype} payload of {r.bytes_per_call} bytes/call "
+                f"(shapes {[list(s) for s in r.shapes]}) — quantization "
+                "silently fell back to wide floats",
+                **r.to_dict(),
+            )
+        if not any(r.dtype == want for r in census
+                   if r.primitive == "ppermute"):
+            report.add(
+                "collective_census", "error", "missing-lowp-rings",
+                f"{name}: low_precision={lp} but no {want} ppermute "
+                "payload exists in the step",
+            )
     if cfg.parallel.fsdp_overlap:
         model_axis = trainer.env.axis_size("model")
         slices = _param_slice_shapes(state_shapes, model_axis)
@@ -333,11 +383,18 @@ def lint_train_step(
 
 
 def lint_decode_step(
-    *, seq_len: int = 96, bucket: int = 16, num_slots: int = 2
+    *, seq_len: int = 96, bucket: int = 16, num_slots: int = 2,
+    kv_cache_quant: str = "none",
 ) -> Report:
     """Lint the serving decode path (tiny GPT, bucketed cache): PR 4's
     no-full-seq_len pin as a materialization-budget finding, plus the
-    engine decode/graft donation audit."""
+    engine decode/graft donation audit.
+
+    With ``kv_cache_quant`` set, the program is the QUANTIZED decode step
+    and gains the ISSUE-6 pin: no wide-float intermediate carrying the
+    cache geometry ``(bucket, H, hd)`` — a step that dequantizes the
+    whole cache (instead of per chunk) is an error
+    (``analysis.materialization.wide_intermediates_with_dims``)."""
     import jax
     import jax.numpy as jnp
 
@@ -352,11 +409,15 @@ def lint_decode_step(
     from frl_distributed_ml_scaffold_tpu.precision import get_policy
     from frl_distributed_ml_scaffold_tpu.serving.engine import ServingEngine
 
-    report = Report(program="serving:decode_step")
+    quant = kv_cache_quant != "none"
+    report = Report(
+        program="serving:decode_step_int8kv" if quant
+        else "serving:decode_step"
+    )
     model = GPT(
         GPTConfig(
             vocab_size=64, num_layers=2, num_heads=2, hidden_dim=32,
-            seq_len=seq_len, dropout=0.0,
+            seq_len=seq_len, dropout=0.0, kv_cache_quant=kv_cache_quant,
         ),
         get_policy(PrecisionConfig(policy="fp32")),
     )
@@ -388,6 +449,22 @@ def lint_decode_step(
             jaxpr, forbidden_dim=seq_len, label="decode_step: "
         )
     )
+    if quant:
+        from frl_distributed_ml_scaffold_tpu.analysis.materialization import (
+            wide_intermediates_with_dims,
+        )
+
+        h = model.config.num_heads
+        hd = model.config.hidden_dim // h
+        for i in wide_intermediates_with_dims(jaxpr, (bucket, h, hd)):
+            report.add(
+                "materialization", "error", "dequantized-cache",
+                f"quantized decode step materializes a wide-float cache-"
+                f"geometry array {i.dtype}{list(i.shape)} ({i.bytes} "
+                f"bytes, {i.primitive}) — the whole cache was "
+                "dequantized instead of per split-KV chunk",
+                intermediate=i.to_dict(), geometry=[bucket, h, hd],
+            )
 
     # Engine decode/graft donation: the KV cache is the serving-side
     # optimizer state — it must be donated or every decode step holds
@@ -498,6 +575,10 @@ def lint_all(
             emit(r)
     if serving:
         emit(lint_decode_step())
+        # The quantized-cache decode step is its own compiled-shape class
+        # in production (model.kv_cache_quant) — lint it as its own
+        # program, with the dequantized-cache pin armed.
+        emit(lint_decode_step(kv_cache_quant="int8"))
     if hygiene:
         emit(lint_hygiene())
     return reports

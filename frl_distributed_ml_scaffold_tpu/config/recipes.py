@@ -227,6 +227,25 @@ def gpt2_medium_tp_overlap() -> ExperimentConfig:
     )
 
 
+@register_config("gpt2_medium_tp_overlap_int8")
+def gpt2_medium_tp_overlap_int8() -> ExperimentConfig:
+    """The low-precision fast path on the tp_overlap flagship: the four
+    per-block collective-matmul rings ppermute int8 chunks + scales and
+    run their matmuls on the MXU's 8-bit path (per-tensor activation /
+    per-channel weight scales, bf16 master weights, straight-through
+    grads — ops/quantization.py, parallel.low_precision). Comm bytes on
+    the model-axis collective-permute class shrink with the element width
+    (graft-lint pins it per dtype: a ring that ppermutes wide floats
+    under this recipe is a lint error). Numerics vs the bf16/fp32 rings
+    are tolerance-gated in tests/test_low_precision.py; the on-chip A/B
+    rides the tp_overlap sweep slot (BACKLOG R7)."""
+    base = gpt2_medium_tp_overlap()
+    return base.replace(
+        name="gpt2_medium_tp_overlap_int8",
+        parallel=dataclasses.replace(base.parallel, low_precision="int8"),
+    )
+
+
 # ----- task-required parallelism showcases beyond the reference configs -----
 
 

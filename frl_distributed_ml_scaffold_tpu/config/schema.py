@@ -96,6 +96,18 @@ class ParallelConfig:
       model family with hooks (gpt, vit, video); composes with data/fsdp
       meshes and ``fsdp_overlap``, not with pipeline/sequence parallelism
       or MoE.
+    - ``low_precision``: the low-precision fast path for the collective-
+      matmul rings ("none" | "int8" | "fp8_e4m3" | "fp8_e5m2",
+      ops/quantization.py): the four hooked TP matmuls run as scaled
+      low-precision matmuls (per-tensor activation scales, per-channel
+      weight scales, bf16/fp32 master weights, straight-through grads)
+      and the rings ``ppermute`` the QUANTIZED chunks + scales — comm
+      bytes on the model axis shrink with the element width (4x at fp32,
+      2x at bf16), pinned by graft-lint's per-dtype collective census.
+      Requires ``tp_overlap=true`` (the knob quantizes the rings; there
+      is no GSPMD low-precision path to fall back to). Tolerances and
+      when-to-use guidance: docs/perf_playbook.md "Low-precision fast
+      path".
     """
 
     param_sharding: str = "replicated"  # replicated | fsdp
@@ -105,6 +117,7 @@ class ParallelConfig:
     fsdp_overlap: bool = False
     fsdp_prefetch: int = 1
     tp_overlap: bool = False
+    low_precision: str = "none"  # none | int8 | fp8_e4m3 | fp8_e5m2
 
 
 @dataclass(frozen=True)
@@ -350,6 +363,17 @@ class GPTConfig:
     # masked-dense reference. Orthogonal to ``attention`` — the training
     # kernels are pointless at one-token query shapes.
     decode_attention: str = "flash"
+    # Quantized KV cache ("none" | "int8" | "fp8_e4m3"): decode stores
+    # K/V in the 1-byte format with per-(row, position, head) bf16 scales
+    # carried alongside (each written token quantizes once, over its own
+    # head vector, and is never re-quantized) — cache HBM per slot drops
+    # ~2x vs bf16 at matched decode tolerance, which is what caps
+    # servable concurrent slots (serving/engine.py accounting,
+    # tools/serve_bench.py int8 arms). The flash-decode kernel
+    # dequantizes per split-KV chunk in VMEM; the dense fallback
+    # dequantizes in bounded chunks — no full-precision full-context
+    # tensor materializes in a decode step (graft-lint pinned).
+    kv_cache_quant: str = "none"
     # Chunked-vocab LM loss: compute the weight-tied head + cross-entropy
     # in sequence chunks of this many tokens (rematerialized in backward),
     # so the [B, T, vocab] logits tensor never materializes — for

@@ -211,16 +211,74 @@ def generate(
 def cache_batch_axis(leaf, batch_rows: int) -> int | None:
     """THE decode-cache leaf taxonomy, in one place: which axis of a
     cache leaf carries the request/beam rows. Per-layer K/V stacks
-    ``[L, B, S, H, hd]`` and ``cache_index`` ``[L, B]`` carry them on
-    axis 1; the model-level ``pos_index`` ``[B]`` leads with them; other
-    leaves (none today) carry no rows. Every per-row cache transform —
-    beam gather/repeat here, the serving engine's slot grafts — must
-    agree with this classification, so route through it."""
+    ``[L, B, S, H, hd]``, their quantization-scale stacks
+    ``[L, B, S, H]`` (``kv_cache_quant``), and ``cache_index``
+    ``[L, B]`` carry them on axis 1; the model-level ``pos_index``
+    ``[B]`` leads with them; other leaves (none today) carry no rows.
+    Every per-row cache transform — beam gather/repeat here, the serving
+    engine's slot grafts — must agree with this classification, so route
+    through it."""
     if leaf.ndim >= 2 and leaf.shape[1] == batch_rows:
         return 1
     if leaf.ndim == 1 and leaf.shape[0] == batch_rows:
         return 0
     return None
+
+
+def cache_capacity_axis(leaf, cache_len: int) -> int | None:
+    """The taxonomy's second question: which axis carries the cache
+    CAPACITY (the bucketed S dim the engine grows). K/V stacks
+    ``[L, B, S, H, hd]`` and scale stacks ``[L, B, S, H]`` both carry it
+    on axis 2; index/position bookkeeping carries none. The engine's
+    bucket growth and empty-cache widening route through this (the same
+    lockstep contract as ``cache_batch_axis``) — a new capacity-bearing
+    leaf class added to the model extends serving by extending THIS
+    function, not three ad-hoc ``ndim == 5`` checks."""
+    if leaf.ndim >= 4 and leaf.shape[2] == cache_len:
+        return 2
+    return None
+
+
+def cache_bytes_per_slot(cache, num_slots: int) -> int:
+    """Per-slot HBM bytes of a decode cache tree, from the ACTUAL leaves
+    — quantization scale tensors and bookkeeping included, which is what
+    keeps bucket HBM estimates (engine slot accounting,
+    tools/serve_bench.py bytes-per-slot) honest: an int8 cache is
+    ``(hd + 2·scale_bytes/…)`` per element-row, not a free 4x."""
+    import numpy as np
+
+    total = 0
+    for leaf in jax.tree.leaves(cache):
+        ax = cache_batch_axis(leaf, num_slots)
+        if ax is None:
+            continue
+        per_row = int(np.prod(leaf.shape, dtype=np.int64)) // leaf.shape[ax]
+        total += per_row * jnp.dtype(leaf.dtype).itemsize
+    return int(total)
+
+
+def estimate_cache_bytes_per_slot(
+    cfg: Any, cache_len: int, *, kv_dtype_bytes: int = 2
+) -> int:
+    """Analytic twin of ``cache_bytes_per_slot`` for capacity planning
+    BEFORE a cache exists: per decode slot at bucket ``cache_len``, a
+    GPT config costs ``L x (K + V (+ scales) + cache_index) +
+    pos_index`` bytes. ``kv_dtype_bytes`` is the UNQUANTIZED element
+    width (2 for bf16 serving, 4 for the fp32 sim); with
+    ``cfg.kv_cache_quant`` set, K/V cost 1 byte and the per-(position,
+    head) bf16 scales ride alongside. Pinned equal to the actual cache
+    tree in tests/test_serving.py — if the model grows a cache leaf this
+    estimate doesn't know, that regression test is what catches the
+    drift."""
+    h = cfg.num_heads
+    hd = cfg.hidden_dim // h
+    quant = getattr(cfg, "kv_cache_quant", "none") != "none"
+    elem = 1 if quant else kv_dtype_bytes
+    per_layer = 2 * cache_len * h * hd * elem  # K + V payloads
+    if quant:
+        per_layer += 2 * cache_len * h * 2  # bf16 scale per (pos, head)
+    per_layer += 4  # cache_index int32
+    return cfg.num_layers * per_layer + 4  # + pos_index int32
 
 
 def _gather_cache_rows(cache, rows, batch_rows: int):

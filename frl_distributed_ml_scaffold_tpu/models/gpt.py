@@ -130,9 +130,11 @@ def _masked_dense_attention(q, k, v, mask):
 
 
 def _constrain_kv_cache(x: jnp.ndarray) -> jnp.ndarray:
-    """Pin a [B, S, H, hd] cache leaf model-sharded over the mesh's
-    ``model`` axis (heads split — the Megatron layout the projection
-    kernels already carry), batch over the batch axes when divisible.
+    """Pin a cache leaf — [B, S, H, hd] K/V values or their [B, S, H]
+    quantization scales — model-sharded over the mesh's ``model`` axis
+    (heads split on axis 2 either way — the Megatron layout the
+    projection kernels already carry), batch over the batch axes when
+    divisible.
 
     This is what keeps multi-chip serving from silently running the cache
     replicated: prefill EMITS the cache in this layout and every decode
@@ -147,13 +149,14 @@ def _constrain_kv_cache(x: jnp.ndarray) -> jnp.ndarray:
     env = current_mesh_env()
     if env is None or env.axis_size("model") <= 1:
         return x
-    if x.shape[2] % env.axis_size("model") != 0:
+    if x.ndim < 3 or x.shape[2] % env.axis_size("model") != 0:
         return x
     batch = BATCH_AXES if x.shape[0] % env.batch_axis_size == 0 else None
     from jax.sharding import NamedSharding
 
+    spec = P(batch, None, "model", *([None] * (x.ndim - 3)))
     return jax.lax.with_sharding_constraint(
-        x, NamedSharding(env.mesh, P(batch, None, "model", None))
+        x, NamedSharding(env.mesh, spec)
     )
 
 
@@ -213,14 +216,36 @@ class CausalSelfAttention(nn.Module):
             # ops/decode_attention (flash-decode kernel or its
             # identical-numerics dense fallback, per cfg.decode_attention).
             s = self.cache_len or cfg.seq_len
+            # Quantized cache (cfg.kv_cache_quant): K/V live in the 1-byte
+            # format with per-(row, position, head) bf16 scales in sibling
+            # cache vars. Each written token quantizes ONCE, over its own
+            # head vector — cache entries are never re-quantized, so the
+            # values a position contributes are identical at every later
+            # step and in every bucket size.
+            quant = cfg.kv_cache_quant != "none"
+            if quant:
+                from frl_distributed_ml_scaffold_tpu.ops.quantization import (
+                    lowp_dtype,
+                )
+
+                cache_dtype = lowp_dtype(cfg.kv_cache_quant)
+            else:
+                cache_dtype = self.dtype
             # Cache vars are created lazily on first use: flax permits
             # variable creation during apply when the collection is mutable.
             ck = self.variable(
-                "cache", "cached_key", jnp.zeros, (b, s, h, hd), self.dtype
+                "cache", "cached_key", jnp.zeros, (b, s, h, hd), cache_dtype
             )
             cv = self.variable(
-                "cache", "cached_value", jnp.zeros, (b, s, h, hd), self.dtype
+                "cache", "cached_value", jnp.zeros, (b, s, h, hd), cache_dtype
             )
+            if quant:
+                ksc = self.variable(
+                    "cache", "key_scale", jnp.zeros, (b, s, h), jnp.bfloat16
+                )
+                vsc = self.variable(
+                    "cache", "value_scale", jnp.zeros, (b, s, h), jnp.bfloat16
+                )
             # Per-ROW write index: serving slots decode at different
             # occupancies (continuous batching), so the index is [B], the
             # write is a batched scatter, and the mask is per-row.
@@ -252,6 +277,27 @@ class CausalSelfAttention(nn.Module):
                 )
             rows = jnp.arange(b)[:, None]
             write_cols = jnp.clip(idx[:, None] + jnp.arange(t)[None, :], 0, s - 1)
+            if quant:
+                from frl_distributed_ml_scaffold_tpu.ops.quantization import (
+                    dequantize,
+                    quantize,
+                )
+
+                qk, sk = quantize(k_w, cfg.kv_cache_quant,
+                                  channel_axes=(0, 1, 2))
+                qv, sv = quantize(v_w, cfg.kv_cache_quant,
+                                  channel_axes=(0, 1, 2))
+                k_w, v_w = qk, qv  # [B, t, H, hd] 1-byte payloads
+                ksc.value = _constrain_kv_cache(
+                    ksc.value.at[rows, write_cols].set(
+                        sk[..., 0].astype(ksc.value.dtype)
+                    )
+                )
+                vsc.value = _constrain_kv_cache(
+                    vsc.value.at[rows, write_cols].set(
+                        sv[..., 0].astype(vsc.value.dtype)
+                    )
+                )
             ck.value = _constrain_kv_cache(
                 ck.value.at[rows, write_cols].set(k_w)
             )
@@ -265,6 +311,8 @@ class CausalSelfAttention(nn.Module):
 
                 y = decode_attention(
                     q[:, 0], ck.value, cv.value, idx + 1,
+                    k_scale=ksc.value if quant else None,
+                    v_scale=vsc.value if quant else None,
                     impl=cfg.decode_attention,
                 )[:, None]
             else:
@@ -276,7 +324,20 @@ class CausalSelfAttention(nn.Module):
                 )  # [B, t]
                 kpos = jnp.arange(s)
                 mask = kpos[None, None, :] <= qpos[:, :, None]  # [B, t, S]
-                y = _masked_dense_attention(q, ck.value, cv.value, mask)
+                if quant:
+                    # Prefill attends over the dequantized bucket — a
+                    # [B, bucket, H, hd] widening is the prefill program's
+                    # own working-set class (its score tensor is bigger);
+                    # the per-STEP no-wide-cache pin applies to t == 1.
+                    k_att = dequantize(
+                        ck.value, ksc.value[..., None], self.dtype
+                    )
+                    v_att = dequantize(
+                        cv.value, vsc.value[..., None], self.dtype
+                    )
+                else:
+                    k_att, v_att = ck.value, cv.value
+                y = _masked_dense_attention(q, k_att, v_att, mask)
             ci.value = idx + lens
         elif cfg.attention == "ring":
             from frl_distributed_ml_scaffold_tpu.ops.ring_attention import (

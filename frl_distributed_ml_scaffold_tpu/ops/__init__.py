@@ -19,10 +19,16 @@ sharding annotations alone:
 - ``decode_attention`` — the serving-side fused split-KV single-token
   decode kernel over the KV cache (length-masked to the occupied prefix,
   head-sharded over the ``model`` axis under a mesh) with
-  ``dense_decode_attention`` as its identical-numerics reference.
+  ``dense_decode_attention`` as its identical-numerics reference; both
+  accept a quantized cache (1-byte K/V + per-position-per-head scales)
+  and dequantize per chunk.
 
 All are drop-in (B, T, H, D)-shaped attention functions used by the GPT
-model's ``attention=`` config switch.
+model's ``attention=`` config switch. ``quantize``/``dequantize``/
+``quantized_matmul`` (ops/quantization.py) are the low-precision
+substrate shared by the collective-matmul rings
+(``parallel.low_precision``) and the quantized KV cache
+(``model.kv_cache_quant``).
 """
 
 from frl_distributed_ml_scaffold_tpu.ops.flash_attention import flash_attention
@@ -38,4 +44,9 @@ from frl_distributed_ml_scaffold_tpu.ops.ulysses import ulysses_attention
 from frl_distributed_ml_scaffold_tpu.ops.decode_attention import (
     decode_attention,
     dense_decode_attention,
+)
+from frl_distributed_ml_scaffold_tpu.ops.quantization import (
+    dequantize,
+    quantize,
+    quantized_matmul,
 )

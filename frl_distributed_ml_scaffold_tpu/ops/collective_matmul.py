@@ -30,6 +30,24 @@ forward schedule of the other (the gather's transpose IS the
 reduce-scatter), with the weight gradient accumulated blockwise inside the
 same ring — so no full-size gathered activation is saved or rebuilt
 monolithically in either direction.
+
+Low-precision fast path (``lowp="int8" | "fp8_e4m3" | "fp8_e5m2"``,
+ROADMAP item 5): the rings are bandwidth-bound, so shrinking the bytes
+they move is a compounding win on top of the overlap itself. With
+``lowp`` set, every ``ppermute`` moves QUANTIZED payloads
+(ops/quantization.py): streamed chunks are quantized ONCE per-tensor
+before entering the ring and ride the wire as 1-byte elements next to
+their scalar scale; rotating partial-sum accumulators are re-quantized
+per hop (error ~qmax⁻¹ per hop, tolerance-gated in
+tests/test_low_precision.py); and the matmul at each visit runs in low
+precision against the per-channel-quantized resident weight (int8 on the
+MXU's integer path, exact int32 accumulation). Gradients take the
+straight-through path: the custom VJPs keep their full-precision
+residuals and blockwise-dw structure, but the backward rings' own
+transfers are quantized the same way — 4x fewer bytes on the model-axis
+collective-permute class at fp32 (2x at bf16), pinned by graft-lint's
+per-dtype collective census
+(``analysis.pins.assert_collective_bytes_within``).
 """
 
 from __future__ import annotations
@@ -41,6 +59,11 @@ import jax.numpy as jnp
 from jax import lax
 
 from frl_distributed_ml_scaffold_tpu.dist import collectives
+from frl_distributed_ml_scaffold_tpu.ops.quantization import (
+    dequantize,
+    qdot,
+    quantize,
+)
 
 
 def _ring_perms(n: int):
@@ -94,6 +117,7 @@ def _stream_ring(
     wgrad_order: str = "lhs",
     return_full: bool = False,
     precision=None,
+    lowp: str | None = None,
 ):
     """Bidirectional ppermute ring over ``x``'s shards.
 
@@ -105,6 +129,13 @@ def _stream_ring(
     - ``w``:          y[rows c] = chunk @ w        (all-gather-matmul)
     - ``return_full``: full[rows c] = chunk        (assembled gather)
     - ``stationary``:  dw += wgrad(chunk, stationary[rows c])
+
+    With ``lowp`` set, each chunk is quantized per-tensor ONCE before
+    entering the ring and the hops move (1-byte payload, scalar scale)
+    pairs; the visit matmul runs quantized against the per-channel
+    quantized resident ``w``, and the ``full``/wgrad consumers see the
+    dequantized values (every rank reconstructs the identical array —
+    the quantization error is applied once, at the source).
 
     Returns ``(y, full, dw)`` with unused slots ``None``.
     """
@@ -125,14 +156,35 @@ def _stream_ring(
         shape = (k, m) if wgrad_order == "lhs" else (m, k)
         dw = jnp.zeros(shape, jnp.float32)
 
+    q_w = s_w = None
+    if lowp is not None and w is not None:
+        # Per-output-channel weight scales: the resident split never
+        # moves, so its quantization is paid once per ring.
+        q_w, s_w = quantize(w, lowp, channel_axes=(w.ndim - 1,))
+
     fwd, bwd = _ring_perms(n)
     half = tc // 2
     bidir = n > 1 and tc % 2 == 0 and tc >= 2
 
-    def visit(y, full, dw, chunk, c, off):
+    def pack(chunk):
+        """Chunk -> wire payload: identity, or (quantized, scale)."""
+        if lowp is None:
+            return chunk
+        return quantize(chunk, lowp)
+
+    def visit(y, full, dw, payload, c, off):
+        if lowp is None:
+            chunk, mm = payload, lambda: _mm(payload, w, precision)
+        else:
+            q_c, s_c = payload
+            chunk = dequantize(q_c, s_c, x.dtype)
+            mm = lambda: qdot(
+                q_c, s_c, q_w, s_w[0],
+                (((q_c.ndim - 1,), (0,)), ((), ())),
+            ).astype(y.dtype)
         start = c * tc + off
         if w is not None:
-            y = _put(y, _mm(chunk, w, precision), start, chunk_axis)
+            y = _put(y, mm().astype(y.dtype), start, chunk_axis)
         if return_full:
             full = _put(full, chunk, start, chunk_axis)
         if stationary is not None:
@@ -142,9 +194,18 @@ def _stream_ring(
             dw = dw + _wgrad(chunk, stat_c, wgrad_order, precision)
         return y, full, dw
 
+    def hop(payload, perm):
+        if lowp is None:
+            return lax.ppermute(payload, axis_name, perm)
+        q_c, s_c = payload
+        return (
+            lax.ppermute(q_c, axis_name, perm),
+            lax.ppermute(s_c, axis_name, perm),
+        )
+
     if bidir:
-        lo = _take(x, 0, half, chunk_axis)
-        hi = _take(x, half, tc - half, chunk_axis)
+        lo = pack(_take(x, 0, half, chunk_axis))
+        hi = pack(_take(x, half, tc - half, chunk_axis))
         c_lo = idx
         c_hi = idx
         for step in range(n):
@@ -154,17 +215,17 @@ def _stream_ring(
                 # lo rides src->src+1 (each device receives from its left
                 # neighbor), hi rides the opposite direction: after s hops
                 # this device holds chunks idx-s and idx+s.
-                lo = lax.ppermute(lo, axis_name, fwd)
-                hi = lax.ppermute(hi, axis_name, bwd)
+                lo = hop(lo, fwd)
+                hi = hop(hi, bwd)
                 c_lo = (c_lo - 1) % n
                 c_hi = (c_hi + 1) % n
     else:
-        chunk = x
+        payload = pack(x)
         c = idx
         for step in range(n):
-            y, full, dw = visit(y, full, dw, chunk, c, 0)
+            y, full, dw = visit(y, full, dw, payload, c, 0)
             if step < n - 1:
-                chunk = lax.ppermute(chunk, axis_name, fwd)
+                payload = hop(payload, fwd)
                 c = (c - 1) % n
     if dw is not None:
         target = jnp.result_type(
@@ -175,7 +236,8 @@ def _stream_ring(
 
 
 def _rotating_ring(
-    y, w, axis_name: str, chunk_axis: int, *, extra=None, precision=None
+    y, w, axis_name: str, chunk_axis: int, *, extra=None, precision=None,
+    lowp: str | None = None,
 ):
     """Rotating-accumulator ring: ``z`` chunk ``c`` = sum over devices j of
     ``y_j[rows c] @ w_j`` (+ ``extra_j[rows c]``), ending on device ``c``.
@@ -183,6 +245,12 @@ def _rotating_ring(
     Bidirectional: the accumulator is split in half along the OUTPUT
     feature dim, one half circulating each direction, so each hop moves
     half-size messages on both links while the next chunk's matmul runs.
+
+    With ``lowp``, the contributing matmuls run quantized (per-tensor
+    chunk x per-channel resident weight) and each hop re-quantizes the
+    partial-sum accumulator for the wire — the one place the fast path
+    pays repeated quantization (n-1 hops of ~qmax⁻¹ relative noise on
+    the running sum; the accumulator itself stays fp32 between hops).
     """
     n = collectives.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
@@ -191,14 +259,36 @@ def _rotating_ring(
     fwd, bwd = _ring_perms(n)
     out_dtype = jnp.result_type(y.dtype, w.dtype)
 
+    q_w = s_w = None
+    if lowp is not None:
+        q_w, s_w = quantize(w, lowp, channel_axes=(w.ndim - 1,))
+
     def contrib(c, col0, cols):
         y_c = _take(y, c * tc, tc, chunk_axis)
-        part = _mm(y_c, w[:, col0 : col0 + cols], precision)
+        if lowp is None:
+            part = _mm(y_c, w[:, col0 : col0 + cols], precision)
+        else:
+            q_c, s_c = quantize(y_c, lowp)
+            part = qdot(
+                q_c, s_c, q_w[:, col0 : col0 + cols],
+                s_w[0, col0 : col0 + cols],
+                (((q_c.ndim - 1,), (0,)), ((), ())),
+            )
         if extra is not None:
             part = part + lax.slice_in_dim(
                 _take(extra, c * tc, tc, chunk_axis), col0, col0 + cols, axis=-1
             ).astype(part.dtype)
         return part
+
+    def hop(acc, perm):
+        if lowp is None:
+            return lax.ppermute(acc, axis_name, perm)
+        q_a, s_a = quantize(acc, lowp)
+        return dequantize(
+            lax.ppermute(q_a, axis_name, perm),
+            lax.ppermute(s_a, axis_name, perm),
+            acc.dtype,
+        )
 
     bidir = n > 1 and d % 2 == 0 and d >= 2
     if bidir:
@@ -214,8 +304,8 @@ def _rotating_ring(
             if step < n - 1:
                 # acc for chunk c walks c+1, c+2, ..., ending home at c
                 # (and mirrored for the other half).
-                acc_lo = lax.ppermute(acc_lo, axis_name, fwd)
-                acc_hi = lax.ppermute(acc_hi, axis_name, bwd)
+                acc_lo = hop(acc_lo, fwd)
+                acc_hi = hop(acc_hi, bwd)
         z = jnp.concatenate([acc_lo, acc_hi], axis=-1)
     else:
         acc = None
@@ -224,7 +314,7 @@ def _rotating_ring(
             p = contrib(c, 0, d)
             acc = p if acc is None else acc + p
             if step < n - 1:
-                acc = lax.ppermute(acc, axis_name, fwd)
+                acc = hop(acc, fwd)
         z = acc
     return z.astype(out_dtype)
 
@@ -232,9 +322,9 @@ def _rotating_ring(
 # ------------------------------------------------------------------ public
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6))
 def all_gather_matmul(x, w, axis_name, chunk_axis, return_full=False,
-                      precision=None):
+                      precision=None, lowp=None):
     """Per-shard blockwise all-gather-matmul (call inside ``shard_map``).
 
     ``x``: this shard's slice along ``chunk_axis``; ``w``: this shard's
@@ -244,28 +334,34 @@ def all_gather_matmul(x, w, axis_name, chunk_axis, return_full=False,
     consumers that share the streamed chunks (the fused QKV projection)
     without paying a second ring.
 
+    ``lowp``: quantize the ring (module docstring) — chunks stream as
+    1-byte payloads + scales, the visit matmuls run in low precision, and
+    ``full`` is assembled from the dequantized chunks (so every sibling
+    consumer sees the same once-quantized values).
+
     Backward: the activation gradient is the transpose schedule
     (``matmul_reduce_scatter`` of ``dy @ w^T``, folding the full-copy
     cotangent into the same rotating accumulators) and ``dw`` accumulates
     blockwise while the chunks stream again — the gathered ``x`` is never
-    saved.
+    saved. Under ``lowp`` the backward rings' transfers quantize too
+    (straight-through: the residuals stay full precision).
     """
     y, full, _ = _stream_ring(
         x, axis_name, chunk_axis, w=w, return_full=return_full,
-        precision=precision,
+        precision=precision, lowp=lowp,
     )
     return (y, full) if return_full else y
 
 
-def _agm_fwd(x, w, axis_name, chunk_axis, return_full, precision):
+def _agm_fwd(x, w, axis_name, chunk_axis, return_full, precision, lowp):
     y, full, _ = _stream_ring(
         x, axis_name, chunk_axis, w=w, return_full=return_full,
-        precision=precision,
+        precision=precision, lowp=lowp,
     )
     return ((y, full) if return_full else y), (x, w)
 
 
-def _agm_bwd(axis_name, chunk_axis, return_full, precision, res, ct):
+def _agm_bwd(axis_name, chunk_axis, return_full, precision, lowp, res, ct):
     x, w = res
     dy, dfull = ct if return_full else (ct, None)
     # dw rides a fresh chunk stream (the backward's re-gather — gathered x
@@ -274,10 +370,11 @@ def _agm_bwd(axis_name, chunk_axis, return_full, precision, res, ct):
     # accumulators (its transpose is exactly a reduce-scatter).
     _, _, dw = _stream_ring(
         x, axis_name, chunk_axis, stationary=dy, wgrad_order="lhs",
-        precision=precision,
+        precision=precision, lowp=lowp,
     )
     dx = _rotating_ring(
-        dy, w.T, axis_name, chunk_axis, extra=dfull, precision=precision
+        dy, w.T, axis_name, chunk_axis, extra=dfull, precision=precision,
+        lowp=lowp,
     )
     return dx.astype(x.dtype), dw.astype(w.dtype)
 
@@ -285,31 +382,37 @@ def _agm_bwd(axis_name, chunk_axis, return_full, precision, res, ct):
 all_gather_matmul.defvjp(_agm_fwd, _agm_bwd)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
-def matmul_reduce_scatter(y, w, axis_name, chunk_axis, precision=None):
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def matmul_reduce_scatter(y, w, axis_name, chunk_axis, precision=None,
+                          lowp=None):
     """Per-shard blockwise matmul-reduce-scatter (call inside ``shard_map``).
 
     ``y``: gathered-along-``chunk_axis``, feature-split ``[..., M_local]``
     input; ``w``: this shard's row split ``[M_local, K]``. Returns this
     shard's chunk of ``sum_shards(y @ w)`` — the Megatron row-parallel
     output, reduced AND scattered by the rotating ring instead of a
-    monolithic allreduce.
+    monolithic allreduce. ``lowp`` quantizes the contributing matmuls and
+    the per-hop accumulator transfers (module docstring).
 
     Backward: ``dy`` is the sibling ``all_gather_matmul`` schedule over the
     incoming chunk cotangents times ``w^T``, and ``dw`` accumulates
     blockwise against the SAME streamed chunks — one ring serves both.
     """
-    return _rotating_ring(y, w, axis_name, chunk_axis, precision=precision)
+    return _rotating_ring(
+        y, w, axis_name, chunk_axis, precision=precision, lowp=lowp
+    )
 
 
-def _mrs_fwd(y, w, axis_name, chunk_axis, precision):
+def _mrs_fwd(y, w, axis_name, chunk_axis, precision, lowp):
     return (
-        _rotating_ring(y, w, axis_name, chunk_axis, precision=precision),
+        _rotating_ring(
+            y, w, axis_name, chunk_axis, precision=precision, lowp=lowp
+        ),
         (y, w),
     )
 
 
-def _mrs_bwd(axis_name, chunk_axis, precision, res, dz):
+def _mrs_bwd(axis_name, chunk_axis, precision, lowp, res, dz):
     y, w = res
     dy, _, dw = _stream_ring(
         dz,
@@ -319,6 +422,7 @@ def _mrs_bwd(axis_name, chunk_axis, precision, res, dz):
         stationary=y,
         wgrad_order="rhs",
         precision=precision,
+        lowp=lowp,
     )
     return dy.astype(y.dtype), dw.astype(w.dtype)
 

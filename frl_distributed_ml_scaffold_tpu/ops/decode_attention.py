@@ -33,6 +33,19 @@ On non-TPU backends the kernel runs under the Pallas interpreter when
 ``interpret=True`` is forced (tests); the default off-TPU path is the
 identical-numerics ``dense_decode_attention`` — the same silent-fallback
 contract as ``flash_attention`` / ``fused_bn``.
+
+Quantized KV cache (``model.kv_cache_quant``, ROADMAP item 5): decode is
+HBM-bandwidth-bound and the cache is what it reads, so K/V may arrive
+here quantized — 1-byte elements (int8 / fp8, ops/quantization.py) plus
+per-(row, position, head) scales. The kernel dequantizes PER SPLIT-KV
+CHUNK in VMEM: the int8 chunk is upcast in-register and the scale folds
+into the score strip after the dot (scale-per-position factors out of
+the contraction over head_dim), so the full-precision cache never exists
+in HBM — not at ``[B, S, H, D]``, not per step. The dense fallback keeps
+the same property by streaming bounded chunks through an online-softmax
+``lax.scan`` (``dense_decode_attention_quant``); graft-lint pins that no
+wide-dtype cache-shaped intermediate materializes in a quantized decode
+step.
 """
 
 from __future__ import annotations
@@ -80,6 +93,82 @@ def dense_decode_attention(
         preferred_element_type=jnp.float32,
     )
     return o.astype(q.dtype)
+
+
+def dense_decode_attention_quant(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    kv_len: jax.Array,
+    k_scale: jax.Array,
+    v_scale: jax.Array,
+    *,
+    block: int | None = None,
+) -> jax.Array:
+    """Reference decode attention over a QUANTIZED cache: k/v are 1-byte
+    ``[B, S, H, D]`` payloads with ``[B, S, H]`` scales.
+
+    Deliberately NOT "dequantize the cache, call the dense reference":
+    that materializes a full-precision cache-sized tensor every decode
+    step — exactly the allocation the quantized cache exists to avoid
+    (and the graft-lint mutation gate for it). Instead the cache streams
+    through an online-softmax ``lax.scan`` in chunks of ``block``
+    positions: each iteration dequantizes one bounded ``[B, block, H, D]``
+    chunk, folds the per-position scales into the score strip / the
+    probability row, and merges with the standard log-sum-exp rescale —
+    the same merge the Pallas kernel and the flash kernels use, in plain
+    XLA. fp32 softmax throughout (the decode numerics contract).
+    """
+    b, s, h, d = k.shape
+    if block is None:
+        # Largest power-of-two divisor of S capped at min(64, S/2): the
+        # cap at S/2 keeps the dequantized chunk STRICTLY smaller than
+        # the bucket at every size, so the "no wide cache-geometry
+        # intermediate" pin holds even for the smallest buckets.
+        cap = min(64, max(1, s // 2))
+        block = next(
+            c for c in (64, 32, 16, 8, 4, 2, 1) if c <= cap and s % c == 0
+        )
+    n = s // block
+    q32 = q.astype(jnp.float32)
+    inv = 1.0 / np.sqrt(d)
+    # [n, B, block, H, ...] chunk stacks (1-byte reshapes — no widening).
+    kc = jnp.moveaxis(k.reshape(b, n, block, h, d), 1, 0)
+    vc = jnp.moveaxis(v.reshape(b, n, block, h, d), 1, 0)
+    ksc = jnp.moveaxis(
+        k_scale.astype(jnp.float32).reshape(b, n, block, h), 1, 0
+    )
+    vsc = jnp.moveaxis(
+        v_scale.astype(jnp.float32).reshape(b, n, block, h), 1, 0
+    )
+
+    def step(carry, xs):
+        m, l, acc, j = carry
+        k_q, k_s, v_q, v_s = xs
+        k_f = k_q.astype(jnp.float32)  # [B, block, H, D] — bounded
+        sc = jnp.einsum("bhd,bchd->bhc", q32, k_f)
+        sc = sc * jnp.moveaxis(k_s, 1, 2) * inv  # scale per (b, h, pos)
+        kpos = j * block + jnp.arange(block)
+        mask = kpos[None, None, :] < kv_len[:, None, None]
+        sc = jnp.where(mask, sc, _NEG_INF)
+        m_new = jnp.maximum(m, sc.max(axis=-1, keepdims=True))
+        p = jnp.where(mask, jnp.exp(sc - m_new), 0.0)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + p.sum(axis=-1, keepdims=True)
+        pv = p * jnp.moveaxis(v_s, 1, 2)  # fold v scales into the probs
+        acc = acc * alpha + jnp.einsum(
+            "bhc,bchd->bhd", pv, v_q.astype(jnp.float32)
+        )
+        return (m_new, l, acc, j + 1), None
+
+    carry0 = (
+        jnp.full((b, h, 1), _NEG_INF, jnp.float32),
+        jnp.zeros((b, h, 1), jnp.float32),
+        jnp.zeros((b, h, d), jnp.float32),
+        jnp.int32(0),
+    )
+    (m, l, acc, _), _ = jax.lax.scan(step, carry0, (kc, ksc, vc, vsc))
+    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
 
 # ------------------------------------------------------------------ kernel
@@ -133,6 +222,56 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
         o_ref[0, :, 0, :] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
 
 
+def _decode_kernel_quant(len_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref,
+                         o_ref, m_ref, l_ref, acc_ref, *, block_k, scale):
+    """Quantized-cache sibling of ``_decode_kernel``: k/v arrive as 1-byte
+    payloads with per-(head, position) scales. The chunk dequantizes IN
+    VMEM — the payload upcasts in-register for the dot and the scale
+    folds into the score strip / probability row afterwards (it factors
+    out of the head_dim contraction), so no full-precision cache chunk
+    ever round-trips through HBM."""
+    b_, j = pl.program_id(0), pl.program_id(1)
+    n_k = pl.num_programs(1)
+    length = len_ref[b_]
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when(j * block_k < length)
+    def _step():
+        q = q_ref[0, :, 0, :].astype(jnp.float32)  # (H, D)
+        k_blk = k_ref[0].astype(jnp.float32)  # (H, Bk, D) — VMEM upcast
+        v_blk = v_ref[0].astype(jnp.float32)
+        k_s = ks_ref[0]  # (H, Bk) fp32 scales
+        v_s = vs_ref[0]
+        s = lax.dot_general(
+            q, k_blk,
+            dimension_numbers=(((1,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * k_s * scale
+        kpos = j * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(kpos < length, s, _NEG_INF)
+        m = m_ref[:]
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        m_ref[:] = m_new
+        l_ref[:] = l_ref[:] * alpha + p.sum(axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + lax.dot_general(
+            p * v_s, v_blk,
+            dimension_numbers=(((1,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == n_k - 1)
+    def _finish():
+        l_safe = jnp.maximum(l_ref[:], 1e-30)
+        o_ref[0, :, 0, :] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+
+
 def _kv_index_map(block_k):
     """Clamp the chunk index to the row's last OCCUPIED chunk: programs
     past the occupancy re-reference the chunk already resident, so no DMA
@@ -143,6 +282,16 @@ def _kv_index_map(block_k):
     def index_map(b_, j, len_ref):
         last = jnp.maximum((len_ref[b_] - 1) // block_k, 0)
         return (b_, 0, jnp.minimum(j, last), 0)
+
+    return index_map
+
+
+def _kv_scale_index_map(block_k):
+    """The scale arrays' ([B, H, S]-layout) twin of ``_kv_index_map``."""
+
+    def index_map(b_, j, len_ref):
+        last = jnp.maximum((len_ref[b_] - 1) // block_k, 0)
+        return (b_, 0, jnp.minimum(j, last))
 
     return index_map
 
@@ -175,6 +324,36 @@ def _flash_decode(q, k, v, kv_len, *, block_k, interpret):
     )(kv_len, q, k, v)
 
 
+def _flash_decode_quant(q, k, k_scale, v, v_scale, kv_len, *, block_k,
+                        interpret):
+    """Quantized-cache split-KV decode: q ``[B, H, 1, D]`` float, k/v
+    ``[B, H, S, D]`` 1-byte payloads, scales ``[B, H, S]`` fp32."""
+    b, h, s, d = k.shape
+    n_k = s // block_k
+    q_spec = pl.BlockSpec((1, h, 1, d), lambda b_, j, len_ref: (b_, 0, 0, 0))
+    kv_spec = pl.BlockSpec((1, h, block_k, d), _kv_index_map(block_k))
+    sc_spec = pl.BlockSpec((1, h, block_k), _kv_scale_index_map(block_k))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, n_k),
+        in_specs=[q_spec, kv_spec, sc_spec, kv_spec, sc_spec],
+        out_specs=q_spec,
+        scratch_shapes=[
+            pltpu.VMEM((h, 1), jnp.float32),  # running max
+            pltpu.VMEM((h, 1), jnp.float32),  # running denom
+            pltpu.VMEM((h, d), jnp.float32),  # output accumulator
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(
+            _decode_kernel_quant, block_k=block_k, scale=1.0 / np.sqrt(d)
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(kv_len, q, k, k_scale, v, v_scale)
+
+
 # ------------------------------------------------------------------ router
 
 
@@ -184,10 +363,22 @@ def _flash_decode(q, k, v, kv_len, *, block_k, interpret):
 _PREFERRED_BLOCK_K = 512
 
 
-def _local_decode(q, k, v, kv_len, *, impl, interpret):
-    """Decode attention on LOCAL (already per-shard) arrays."""
-    if impl == "dense":
+def _local_decode(q, k, v, kv_len, *, impl, interpret, k_scale=None,
+                  v_scale=None):
+    """Decode attention on LOCAL (already per-shard) arrays; with
+    ``k_scale``/``v_scale`` present the cache is quantized and every
+    branch takes its chunk-dequantizing twin."""
+    quant = k_scale is not None
+
+    def dense():
+        if quant:
+            return dense_decode_attention_quant(
+                q, k, v, kv_len, k_scale, v_scale
+            )
         return dense_decode_attention(q, k, v, kv_len)
+
+    if impl == "dense":
+        return dense()
     if impl != "flash":
         raise KeyError(
             f"unknown decode_attention impl {impl!r} (dense | flash)"
@@ -203,18 +394,27 @@ def _local_decode(q, k, v, kv_len, *, impl, interpret):
                 f"(S={s}, head_dim={d}) is not tileable (need a "
                 "power-of-two divisor of S and head_dim % 32 == 0)"
             )
-        return dense_decode_attention(q, k, v, kv_len)
+        return dense()
     if interpret is None:
         if jax.default_backend() != "tpu":
             # Identical numerics, no interpreter slowdown — the same
             # silent off-TPU contract as flash_attention.
-            return dense_decode_attention(q, k, v, kv_len)
+            return dense()
         interpret = False
     qT = q[:, :, None, :]  # [B, H, 1, D]
     kT = k.transpose(0, 2, 1, 3)  # [B, H, S, D]
     vT = v.transpose(0, 2, 1, 3)
     lens = jnp.maximum(kv_len.astype(jnp.int32), 1)
-    o = _flash_decode(qT, kT, vT, lens, block_k=block_k, interpret=interpret)
+    if quant:
+        o = _flash_decode_quant(
+            qT, kT, k_scale.astype(jnp.float32).transpose(0, 2, 1),
+            vT, v_scale.astype(jnp.float32).transpose(0, 2, 1),
+            lens, block_k=block_k, interpret=interpret,
+        )
+    else:
+        o = _flash_decode(
+            qT, kT, vT, lens, block_k=block_k, interpret=interpret
+        )
     return o[:, :, 0, :]
 
 
@@ -224,6 +424,8 @@ def decode_attention(
     v: jax.Array,
     kv_len: jax.Array,
     *,
+    k_scale: jax.Array | None = None,
+    v_scale: jax.Array | None = None,
     impl: str = "flash",
     interpret: bool | None = None,
 ) -> jax.Array:
@@ -232,7 +434,10 @@ def decode_attention(
     through.
 
     q ``[B, H, D]``, k/v ``[B, S, H, D]`` (cache layout), ``kv_len [B]``
-    int32 occupancy per row. Under a mesh whose ``model`` axis is live the
+    int32 occupancy per row. With ``k_scale``/``v_scale`` (``[B, S, H]``,
+    both or neither) the cache is QUANTIZED (1-byte k/v payloads,
+    ``model.kv_cache_quant``) and every branch dequantizes per chunk —
+    module docstring. Under a mesh whose ``model`` axis is live the
     call runs head-sharded via shard_map (GSPMD cannot partition an opaque
     pallas_call, and even the dense path benefits from a pinned layout):
     each shard attends its local heads against its local cache shard —
@@ -241,7 +446,9 @@ def decode_attention(
     output. The batch dimension shards over the batch axes exactly when
     the cache constraint does (``_constrain_kv_cache``): the two MUST
     agree, or entering this region would all-gather the cache's batch
-    shards — the monolithic reshard the handoff pin forbids.
+    shards — the monolithic reshard the handoff pin forbids. The scale
+    arrays shard like the cache (heads over ``model``) for the same
+    reason.
     """
     from frl_distributed_ml_scaffold_tpu.dist.mesh import (
         BATCH_AXES,
@@ -249,18 +456,38 @@ def decode_attention(
         shard_map_compat,
     )
 
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError(
+            "k_scale and v_scale must be passed together (a quantized "
+            "cache quantizes both of its halves)"
+        )
     env = current_mesh_env()
     m = env.axis_size("model") if env is not None else 1
     h = q.shape[1]
     if env is None or m <= 1 or h % m != 0:
-        return _local_decode(q, k, v, kv_len, impl=impl, interpret=interpret)
+        return _local_decode(
+            q, k, v, kv_len, impl=impl, interpret=interpret,
+            k_scale=k_scale, v_scale=v_scale,
+        )
     batch = BATCH_AXES if q.shape[0] % env.batch_axis_size == 0 else None
     q_spec = P(batch, "model", None)
     kv_spec = P(batch, None, "model", None)
+    if k_scale is None:
+        fn = shard_map_compat(
+            functools.partial(_local_decode, impl=impl, interpret=interpret),
+            mesh=env.mesh,
+            in_specs=(q_spec, kv_spec, kv_spec, P(batch)),
+            out_specs=q_spec,
+        )
+        return fn(q, k, v, kv_len)
+    sc_spec = P(batch, None, "model")
     fn = shard_map_compat(
-        functools.partial(_local_decode, impl=impl, interpret=interpret),
+        lambda q_, k_, v_, l_, ks_, vs_: _local_decode(
+            q_, k_, v_, l_, impl=impl, interpret=interpret,
+            k_scale=ks_, v_scale=vs_,
+        ),
         mesh=env.mesh,
-        in_specs=(q_spec, kv_spec, kv_spec, P(batch)),
+        in_specs=(q_spec, kv_spec, kv_spec, P(batch), sc_spec, sc_spec),
         out_specs=q_spec,
     )
-    return fn(q, k, v, kv_len)
+    return fn(q, k, v, kv_len, k_scale, v_scale)

@@ -1,0 +1,174 @@
+"""Scaled low-precision (int8 / fp8) quantization primitives.
+
+ROADMAP item 5's common substrate: both low-precision consumers — the
+collective-matmul rings (ops/collective_matmul.py ``lowp=``) and the
+quantized KV cache (models/gpt.py ``kv_cache_quant``) — are symmetric
+scaled-integer/fp8 schemes built from the three functions here:
+
+- ``quantize``: ``x ≈ q * scale`` with ``q`` in the target format and
+  ``scale = max|x| / qmax`` over everything except the kept channel axes.
+  Per-tensor (``channel_axes=None``) for streamed ring chunks — one
+  scalar rides the wire next to each chunk — and per-channel for weights
+  (output features keep their own dynamic range) and the KV cache (each
+  written token's heads quantize independently, so a cache entry is
+  never re-quantized after it lands).
+- ``dequantize``: the exact inverse map back to a float dtype.
+- ``qdot`` / ``quantized_matmul``: the scaled matmul. int8 contracts on
+  the integer unit (``preferred_element_type=int32`` — the MXU's native
+  int8 path on TPU, exact on every backend) and applies
+  ``scale_lhs * scale_rhs`` to the fp32 result; fp8 upcasts in-register
+  and contracts with fp32 accumulation. ``quantized_matmul`` carries a
+  straight-through ``custom_vjp``: the forward computes in low precision,
+  the backward differentiates as if the quantizers were identity (the
+  full-precision operands are the residuals) — bf16/fp32 master weights,
+  low-precision compute, standard STE training semantics.
+
+Formats: ``int8`` (the default — 1 byte, exact integer accumulation),
+``fp8_e4m3`` (1 byte, wider dynamic range per element, for
+activation-heavy tensors), ``fp8_e5m2`` (gradient-flavored range). The
+format string is the one vocabulary every knob speaks
+(``parallel.low_precision``, ``model.kv_cache_quant``,
+``collective_matmul(..., lowp=)``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+#: format name -> (storage dtype, largest representable magnitude).
+LOWP_FORMATS: dict[str, tuple] = {
+    "int8": (jnp.int8, 127.0),
+    "fp8_e4m3": (jnp.float8_e4m3fn, 448.0),
+    "fp8_e5m2": (jnp.float8_e5m2, 57344.0),
+}
+
+
+def lowp_dtype(fmt: str):
+    """Storage dtype of a low-precision format name (KeyError on typos,
+    listing the vocabulary — every knob funnels through here)."""
+    if fmt not in LOWP_FORMATS:
+        raise KeyError(
+            f"unknown low-precision format {fmt!r} "
+            f"(known: {sorted(LOWP_FORMATS)})"
+        )
+    return LOWP_FORMATS[fmt][0]
+
+
+def qmax(fmt: str) -> float:
+    """Largest representable magnitude of a format."""
+    lowp_dtype(fmt)
+    return LOWP_FORMATS[fmt][1]
+
+
+def quantize(
+    x: jax.Array,
+    fmt: str,
+    channel_axes: tuple[int, ...] | int | None = None,
+    *,
+    scale_dtype=jnp.float32,
+):
+    """Symmetric scaled quantization: returns ``(q, scale)`` with
+    ``x ≈ q * scale``.
+
+    ``channel_axes`` are the axes that KEEP independent scales (the
+    max-abs reduction runs over all the others); ``None`` means
+    per-tensor. The scale keeps reduced axes as size-1 dims so
+    ``q * scale`` broadcasts back without bookkeeping (callers that
+    store scales squeeze them explicitly).
+    """
+    dtype, m = lowp_dtype(fmt), qmax(fmt)
+    if channel_axes is None:
+        reduce_axes = tuple(range(x.ndim))
+    else:
+        if isinstance(channel_axes, int):
+            channel_axes = (channel_axes,)
+        keep = {a % x.ndim for a in channel_axes}
+        reduce_axes = tuple(a for a in range(x.ndim) if a not in keep)
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=reduce_axes,
+                   keepdims=True)
+    # All-zero slices quantize to zeros with scale 1 (never divide by 0).
+    scale = jnp.where(amax > 0.0, amax / m, 1.0).astype(jnp.float32)
+    y = x.astype(jnp.float32) / scale
+    if fmt == "int8":
+        q = jnp.clip(jnp.round(y), -m, m).astype(dtype)
+    else:
+        q = jnp.clip(y, -m, m).astype(dtype)
+    return q, scale.astype(scale_dtype)
+
+
+def dequantize(q: jax.Array, scale: jax.Array, dtype=jnp.float32):
+    """Inverse of ``quantize``: ``q * scale`` in the requested dtype
+    (``scale`` must broadcast against ``q`` — keepdims scales do, stored
+    squeezed scales need their trailing dim back first)."""
+    return (q.astype(jnp.float32) * scale.astype(jnp.float32)).astype(dtype)
+
+
+def qdot(q_lhs, s_lhs, q_rhs, s_rhs, dimension_numbers, *,
+         preferred=jnp.float32):
+    """Scaled low-precision contraction: both operands already quantized.
+
+    int8 operands contract on the integer path (int32 accumulation —
+    exact, and the TPU MXU's native 8-bit mode); fp8 upcasts to fp32 in
+    register. The result is rescaled by ``s_lhs * s_rhs``, so the scale
+    layouts must broadcast against the contraction OUTPUT (per-tensor
+    scales always do; per-channel rhs scales must live on kept dims).
+    """
+    if q_lhs.dtype == jnp.int8 and q_rhs.dtype == jnp.int8:
+        raw = lax.dot_general(
+            q_lhs, q_rhs, dimension_numbers,
+            preferred_element_type=jnp.int32,
+        ).astype(jnp.float32)
+    else:
+        raw = lax.dot_general(
+            q_lhs.astype(jnp.float32), q_rhs.astype(jnp.float32),
+            dimension_numbers, preferred_element_type=preferred,
+        )
+    return raw * (s_lhs.astype(jnp.float32) * s_rhs.astype(jnp.float32))
+
+
+def _qmm_fwd_impl(x, w, fmt):
+    """[..., K] x [K, M] low-precision matmul: per-tensor x scale,
+    per-output-channel w scale."""
+    q_x, s_x = quantize(x, fmt)
+    q_w, s_w = quantize(w, fmt, channel_axes=(1,))  # scale [1, M]
+    dims = (((x.ndim - 1,), (0,)), ((), ()))
+    # s_x is all-size-1 (broadcasts anywhere); s_w [1, M] broadcasts onto
+    # the [..., M] result's feature dim.
+    y = qdot(q_x, jnp.squeeze(s_x), q_w, s_w[0], dims)
+    return y.astype(jnp.result_type(x.dtype, w.dtype))
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2,))
+def quantized_matmul(x, w, fmt: str):
+    """Straight-through scaled low-precision matmul ``[..., K] @ [K, M]``.
+
+    Forward: quantized compute (``qdot``). Backward: the quantizers are
+    treated as identity (STE) — gradients are the plain matmul's, taken
+    against the full-precision residuals, so master weights keep
+    full-precision updates while the forward pays low-precision compute
+    and (inside the rings) low-precision communication.
+    """
+    return _qmm_fwd_impl(x, w, fmt)
+
+
+def _qmm_fwd(x, w, fmt):
+    return _qmm_fwd_impl(x, w, fmt), (x, w)
+
+
+def _qmm_bwd(fmt, res, dy):
+    x, w = res
+    dims_dx = (((x.ndim - 1,), (1,)), ((), ()))
+    dx = lax.dot_general(dy, w, dims_dx)  # dy @ w^T
+    nb = x.ndim - 1
+    dw = lax.dot_general(
+        x, dy, ((tuple(range(nb)),) * 2, ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return dx.astype(x.dtype), dw.astype(w.dtype)
+
+
+quantized_matmul.defvjp(_qmm_fwd, _qmm_bwd)

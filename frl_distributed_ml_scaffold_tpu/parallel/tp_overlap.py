@@ -93,10 +93,17 @@ class TpHooks:
     ``chunk_axis`` — which activation dim the residual stream shards over
     the model axis: 1 (tokens) for the GPT scan stack, 0 (batch) for
     ViT/video (197 tokens is prime; the batch dim divides instead).
+
+    ``lowp`` — the low-precision fast path (``parallel.low_precision``):
+    when set ("int8" | "fp8_e4m3" | "fp8_e5m2"), the rings ppermute
+    quantized chunks + scales and the four hooked matmuls run as scaled
+    low-precision matmuls with straight-through grads
+    (ops/collective_matmul.py module docstring; ops/quantization.py).
     """
 
     axis: str = "model"
     chunk_axis: int = 1
+    lowp: str | None = None
 
     # ------------------------------------------------------------- specs
 
@@ -159,6 +166,7 @@ class TpHooks:
             chunk_axis=self.chunk_axis,
             return_full=False,
             precision=precision,
+            lowp=self.lowp,
         )
         y2 = shard_map_compat(
             inner,
@@ -189,6 +197,7 @@ class TpHooks:
             axis_name=self.axis,
             chunk_axis=self.chunk_axis,
             precision=precision,
+            lowp=self.lowp,
         )
         z2 = shard_map_compat(
             inner,
@@ -229,7 +238,15 @@ class _QkvContext:
         if self._x_ref is x:
             # Sibling projection of the same input: the gathered copy from
             # the first ring is replicated over the model axis, the kernel
-            # is column-split — a comm-free local matmul under GSPMD.
+            # is column-split — a comm-free local matmul under GSPMD
+            # (quantized under the low-precision fast path, so ALL of the
+            # QKV trio's matmuls run low-precision, not just the ring's).
+            if hooks.lowp is not None:
+                from frl_distributed_ml_scaffold_tpu.ops.quantization import (
+                    quantized_matmul,
+                )
+
+                return restore(quantized_matmul(self._full, w2, hooks.lowp))
             y2 = lax.dot_general(
                 self._full,
                 w2,
@@ -243,6 +260,7 @@ class _QkvContext:
             chunk_axis=hooks.chunk_axis,
             return_full=True,
             precision=precision,
+            lowp=hooks.lowp,
         )
         y2, full = shard_map_compat(
             inner,
@@ -293,6 +311,13 @@ def validate_tp_overlap_config(cfg) -> None:
             "hooks (its dispatch owns the token exchange); set "
             "model.moe.num_experts=0"
         )
+    lp = getattr(cfg.parallel, "low_precision", "none")
+    if lp != "none":
+        from frl_distributed_ml_scaffold_tpu.ops.quantization import (
+            lowp_dtype,
+        )
+
+        lowp_dtype(lp)  # KeyError (with the vocabulary) on typos
 
 
 def make_tp_hooks(cfg, env) -> TpHooks:
@@ -319,6 +344,8 @@ def make_tp_hooks(cfg, env) -> TpHooks:
             "the collective-matmul rings split the Megatron feature dims "
             "exactly, without GSPMD's padding"
         )
+    lowp = getattr(cfg.parallel, "low_precision", "none")
+    lowp = None if lowp == "none" else lowp
     # num_heads need NOT divide by m: the attention segment between the
     # rings stays GSPMD-owned (head-split F is just a feature dim to it,
     # and it pads/reshards as it always did — equivalence is gated at
@@ -330,7 +357,7 @@ def make_tp_hooks(cfg, env) -> TpHooks:
                 f"must divide by mesh.model={m} (the residual stream is "
                 "sequence-sharded over the model axis)"
             )
-        return TpHooks(axis="model", chunk_axis=1)
+        return TpHooks(axis="model", chunk_axis=1, lowp=lowp)
     # vit/video: the token count (1 + patches) is generally not divisible;
     # the batch dim carries the chunking instead.
     per_shard = (
@@ -343,4 +370,4 @@ def make_tp_hooks(cfg, env) -> TpHooks:
             f"divide by data*fsdp*model*grad_accum={per_shard} (the "
             f"{family} residual stream is batch-sharded over the model axis)"
         )
-    return TpHooks(axis="model", chunk_axis=0)
+    return TpHooks(axis="model", chunk_axis=0, lowp=lowp)

@@ -48,6 +48,8 @@ from frl_distributed_ml_scaffold_tpu.models.generation import (
     _prefill,
     _sample,
     cache_batch_axis,
+    cache_bytes_per_slot,
+    cache_capacity_axis,
     next_cache_bucket,
 )
 
@@ -186,6 +188,17 @@ class ServingEngine:
         self.bucket = 0
         self.stats.clear()
 
+    def bytes_per_slot(self) -> int:
+        """Per-slot HBM of the LIVE engine cache at its current bucket —
+        from the actual device arrays, so quantization scale tensors and
+        per-slot bookkeeping are included (the accounting the bucket HBM
+        estimates and serve_bench's bytes-per-slot column must agree
+        with; pinned against ``generation.estimate_cache_bytes_per_slot``
+        in tests/test_serving.py). 0 before the first admission."""
+        if self.cache is None:
+            return 0
+        return cache_bytes_per_slot(self.cache, self.num_slots)
+
     def run(self, max_steps: int | None = None) -> list[Completion]:
         """Drain the queue; returns completions in finish order."""
         out: list[Completion] = []
@@ -272,11 +285,15 @@ class ServingEngine:
 
             def fn(cache):
                 def leaf(e):
-                    if e.ndim == 5:  # pad the cache axis
-                        pad = [(0, 0)] * 5
-                        pad[2] = (0, s_new - s_old)
-                        return jnp.pad(e, pad)
-                    return e
+                    # Pad every capacity-bearing leaf (K/V stacks AND
+                    # their quantization-scale stacks) along the cache
+                    # axis; bookkeeping leaves pass through.
+                    ax = cache_capacity_axis(e, s_old)
+                    if ax is None:
+                        return e
+                    pad = [(0, 0)] * e.ndim
+                    pad[ax] = (0, s_new - s_old)
+                    return jnp.pad(e, pad)
 
                 return jax.tree.map(leaf, cache)
 
@@ -291,8 +308,8 @@ class ServingEngine:
     def _empty_cache(self, slot_cache, s: int):
         """Zeros shaped like a 1-request slot cache widened to the slot
         array (row axis per ``cache_batch_axis``) at cache capacity ``s``
-        (the K/V stacks' cache axis 2 — the one leaf class with a
-        capacity dim, same special case as ``_grow_fn``)."""
+        (capacity-bearing leaves — K/V and scale stacks — per
+        ``cache_capacity_axis``, the same taxonomy ``_grow_fn`` pads)."""
         n = self.num_slots
 
         def leaf(e):
@@ -300,8 +317,9 @@ class ServingEngine:
             assert ax is not None, f"cache leaf {e.shape} carries no rows"
             shape = list(e.shape)
             shape[ax] = n
-            if e.ndim == 5:
-                shape[2] = s
+            cap = cache_capacity_axis(e, s)
+            if cap is not None:
+                shape[cap] = s
             return jnp.zeros(tuple(shape), e.dtype)
 
         return jax.tree.map(leaf, slot_cache)

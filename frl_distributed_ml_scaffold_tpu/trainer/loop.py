@@ -130,6 +130,16 @@ class Trainer:
             )
 
             validate_tp_overlap_config(cfg)
+        elif cfg.parallel.low_precision != "none":
+            # The knob quantizes the collective-matmul rings; without them
+            # it would silently change nothing — the fsdp_overlap/tp_overlap
+            # "no silent fallback" contract.
+            raise ValueError(
+                f"parallel.low_precision={cfg.parallel.low_precision!r} "
+                "requires parallel.tp_overlap=true (the low-precision fast "
+                "path lives in the collective-matmul rings; there is no "
+                "GSPMD low-precision schedule to fall back to)"
+            )
         self.env = mesh_env if mesh_env is not None else build_mesh(cfg.mesh)
         self.policy = get_policy(cfg.precision)
         self.model = create_model(cfg.model, self.policy)

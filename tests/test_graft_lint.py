@@ -313,6 +313,138 @@ def gpt_tiny():
 # --------------------------------------------------------------- donation
 
 
+# -------------------------------------------------- low-precision pins
+
+
+@pytest.mark.fast
+def test_collective_bytes_pin_positive_and_negative():
+    """assert_collective_bytes_within sums (dtype-/axis-filtered) wire
+    bytes: a budget above the measured traffic passes, below fires with
+    the measured total; dtype filtering separates payload from scale
+    traffic."""
+    env = build_mesh(MeshConfig(data=8))
+    perm = [(i, (i + 1) % 8) for i in range(8)]
+
+    def inner(x):
+        q = x.astype(jnp.int8)
+        s = jnp.max(jnp.abs(x))[None]
+        q = jax.lax.ppermute(q, "data", perm)
+        s = jax.lax.ppermute(s, "data", perm)
+        return q.astype(jnp.float32) * s
+
+    f = shard_map_compat(
+        inner, mesh=env.mesh, in_specs=P("data"), out_specs=P("data")
+    )
+    with mesh_context(env):
+        jaxpr = jax.make_jaxpr(f)(jnp.ones((8, 64)))
+    # Payload: [1, 64] int8 = 64 bytes; scale: [1] f32 = 4 bytes.
+    assert pins.collective_bytes(jaxpr, "ppermute") == 68
+    assert pins.collective_bytes(jaxpr, "ppermute", dtypes=("int8",)) == 64
+    pins.assert_collective_bytes_within(
+        jaxpr, "ppermute", 8, dtypes=("float32",)
+    )
+    with pytest.raises(AssertionError, match="bytes"):
+        pins.assert_collective_bytes_within(
+            jaxpr, "ppermute", 32, dtypes=("int8",)
+        )
+
+
+@pytest.mark.fast
+def test_mutation_bf16_ring_under_int8_recipe_trips_bytes_pin(monkeypatch):
+    """THE low-precision mutation gate (ISSUE 6): strip the quantization
+    off the rings while the recipe says low_precision=int8 — the runner's
+    per-dtype census check must flag the wide ppermute payloads (and the
+    missing int8 traffic) as errors. At HEAD the same recipe lints
+    clean (test_lint_train_step_overlap_recipes_enforce_their_pins
+    covers the tp_overlap family positive)."""
+    import dataclasses
+
+    from frl_distributed_ml_scaffold_tpu.analysis.runner import (
+        lint_train_step,
+    )
+    from frl_distributed_ml_scaffold_tpu.parallel import tp_overlap as tpo
+
+    # (The positive — the int8 recipe linting clean at HEAD — rides
+    # test_cli_all_recipes_runs_clean_and_emits_json, which lints every
+    # registered recipe; no need to pay a second trainer build here.)
+    real = tpo.make_tp_hooks
+
+    def sabotaged(cfg, env):
+        return dataclasses.replace(real(cfg, env), lowp=None)
+
+    monkeypatch.setattr(tpo, "make_tp_hooks", sabotaged)
+    rep = lint_train_step(
+        "gpt2_medium_tp_overlap_int8", workdir="/tmp/graft_lint_test"
+    )
+    codes = {f.code for f in rep.errors()}
+    assert "wide-ppermute" in codes and "missing-lowp-rings" in codes, (
+        codes, [f.message for f in rep.errors()][:3],
+    )
+
+
+@pytest.mark.fast
+def test_mutation_wholesale_cache_dequantize_trips_materialization(gpt_tiny):
+    """THE quantized-decode mutation gate: the shipped int8-KV decode
+    step passes the no-wide-cache-geometry pin (it dequantizes per
+    chunk); a deliberately-broken step that dequantizes the WHOLE cache
+    before attending trips the same analyzer."""
+    import dataclasses
+
+    from frl_distributed_ml_scaffold_tpu.models.gpt import (
+        GPT,
+        _masked_dense_attention,
+    )
+    from frl_distributed_ml_scaffold_tpu.ops.quantization import (
+        dequantize,
+        quantize,
+    )
+
+    model, _ = gpt_tiny
+    bucket, h = 16, model.config.num_heads
+    hd = model.config.hidden_dim // h
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(2, 1, h, hd)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(2, bucket, h, hd)), jnp.float32)
+    kq, ks = quantize(k, "int8", channel_axes=(0, 1, 2))
+
+    def broken(q, kq, ks):
+        # The mutation: wholesale dequantize, then dense-attend.
+        kf = dequantize(kq, ks, jnp.float32)  # [B, S, H, hd] fp32
+        mask = jnp.ones((2, 1, bucket), bool)
+        return _masked_dense_attention(q, kf, kf, mask)
+
+    jaxpr = jax.make_jaxpr(broken)(q, kq, ks)
+    with pytest.raises(AssertionError, match="geometry"):
+        pins.assert_no_wide_dims_materialized(jaxpr, (bucket, h, hd))
+
+    def broken_transposed(q, kq, ks):
+        # Same mutation behind a layout transpose ([B, S, H, hd] ->
+        # [B, H, S, hd], the kernel layout): the pin matches the cache
+        # geometry as a dim multiset, so reordering can't dodge it.
+        kf = dequantize(
+            jnp.transpose(kq, (0, 2, 1, 3)),
+            jnp.transpose(ks, (0, 2, 1))[..., None],
+            jnp.float32,
+        )
+        return (q[:, 0, :, None, :] * kf).sum()
+
+    jaxpr_t = jax.make_jaxpr(broken_transposed)(
+        q, kq, jnp.squeeze(ks, -1) if ks.ndim == 4 else ks
+    )
+    with pytest.raises(AssertionError, match="geometry"):
+        pins.assert_no_wide_dims_materialized(jaxpr_t, (bucket, h, hd))
+
+    # The shipped quantized decode step passes (positive gate, runner-
+    # level: same analyzer the CLI arms for serving:decode_step_int8kv).
+    from frl_distributed_ml_scaffold_tpu.analysis.runner import (
+        lint_decode_step,
+    )
+
+    rep = lint_decode_step(kv_cache_quant="int8")
+    assert rep.program == "serving:decode_step_int8kv"
+    assert rep.ok, [f.message for f in rep.errors()]
+
+
 @pytest.mark.fast
 def test_mutation_dropped_donation_is_caught():
     """THE donation mutation gate: the same program jitted with and
@@ -479,6 +611,7 @@ def test_cli_all_recipes_runs_clean_and_emits_json(tmp_path):
     for name in list_configs():
         assert f"recipe:{name}" in programs, programs
     assert "serving:decode_step" in programs
+    assert "serving:decode_step_int8kv" in programs
     assert "hygiene:traced-modules" in programs
     assert all(r["ok"] for r in reports), [
         r["program"] for r in reports if not r["ok"]

@@ -1,0 +1,135 @@
+"""Quantization primitive gates (ops/quantization.py): round-trip error
+bounds per format and granularity, zero-safety, the scaled matmul's
+accuracy against the full-precision dot, and the straight-through VJP
+contract (backward == the plain matmul's gradients, exactly)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from frl_distributed_ml_scaffold_tpu.ops.quantization import (
+    LOWP_FORMATS,
+    dequantize,
+    lowp_dtype,
+    qmax,
+    quantize,
+    quantized_matmul,
+)
+
+pytestmark = pytest.mark.fast
+
+
+@pytest.mark.parametrize("fmt", sorted(LOWP_FORMATS))
+def test_round_trip_error_bound_per_tensor(fmt):
+    """Symmetric per-tensor quantization: |x - deq(q(x))| <= half a
+    quantization step for int8 (round-to-nearest) and <= one fp8 ulp of
+    the scaled value for the float formats."""
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(64, 32)) * 3.0, jnp.float32)
+    q, scale = quantize(x, fmt)
+    assert q.dtype == lowp_dtype(fmt)
+    assert scale.shape == (1, 1)
+    back = dequantize(q, scale)
+    err = float(jnp.abs(back - x).max())
+    s = float(scale[0, 0])
+    if fmt == "int8":
+        assert err <= 0.5 * s + 1e-7, (err, s)
+    else:
+        # fp8 relative step at the top of the range: 2^-mantissa_bits.
+        mant = 3 if fmt == "fp8_e4m3" else 2
+        assert err <= s * qmax(fmt) * 2.0 ** (-mant), (err, s)
+    # The max-magnitude element is exactly representable (scale maps the
+    # amax onto qmax) — symmetric quantization's anchor property.
+    i = jnp.unravel_index(jnp.argmax(jnp.abs(x)), x.shape)
+    np.testing.assert_allclose(float(back[i]), float(x[i]), rtol=1e-6)
+
+
+def test_per_channel_beats_per_tensor_on_skewed_channels():
+    """Per-channel scales exist because channels with small dynamic range
+    must not inherit the largest channel's quantization step."""
+    rng = np.random.default_rng(1)
+    w = jnp.asarray(rng.normal(size=(32, 4)), jnp.float32)
+    w = w * jnp.asarray([100.0, 1.0, 0.01, 0.0001])[None, :]
+    q_t, s_t = quantize(w, "int8")
+    q_c, s_c = quantize(w, "int8", channel_axes=(1,))
+    assert s_c.shape == (1, 4)
+    err_t = jnp.abs(dequantize(q_t, s_t) - w).max(axis=0)
+    err_c = jnp.abs(dequantize(q_c, s_c) - w).max(axis=0)
+    # The small channels are destroyed per-tensor, preserved per-channel.
+    assert float(err_c[2]) < float(err_t[2])
+    assert float(err_c[3]) < float(err_t[3])
+    rel = err_c / jnp.abs(w).max(axis=0)
+    assert float(rel.max()) <= 1.0 / 254 + 1e-6, rel
+
+
+def test_all_zero_input_is_safe():
+    """Zero tensors (fresh cache rows, zero-init layers) must quantize to
+    zeros with a finite scale — never a divide-by-zero NaN."""
+    x = jnp.zeros((8, 8), jnp.float32)
+    q, s = quantize(x, "int8")
+    assert np.all(np.asarray(q) == 0)
+    assert np.all(np.isfinite(np.asarray(s)))
+    np.testing.assert_array_equal(np.asarray(dequantize(q, s)), 0.0)
+
+
+def test_unknown_format_raises_with_vocabulary():
+    with pytest.raises(KeyError, match="int8"):
+        lowp_dtype("int4")
+    with pytest.raises(KeyError, match="fp8_e4m3"):
+        quantize(jnp.ones(3), "bf8")
+
+
+@pytest.mark.parametrize("fmt", ["int8", "fp8_e4m3"])
+def test_quantized_matmul_tracks_plain_matmul(fmt):
+    """The scaled low-precision matmul stays within the documented band
+    of the fp32 product (per-tensor x scale, per-channel w scale)."""
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=(4, 16, 32)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(32, 24)), jnp.float32) * 0.2
+    ref = jnp.einsum("btk,km->btm", x, w)
+    out = quantized_matmul(x, w, fmt)
+    rel = float(jnp.abs(out - ref).max() / jnp.abs(ref).max())
+    assert rel < 0.05, rel
+
+
+def test_quantized_matmul_straight_through_grads_are_exact():
+    """The STE contract: gradients of the quantized matmul equal the
+    PLAIN matmul's gradients exactly — the quantizers differentiate as
+    identity against the full-precision residuals, so master-weight
+    updates see no quantization in the backward."""
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(8, 16)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(16, 8)), jnp.float32)
+    ct = jnp.asarray(rng.normal(size=(8, 8)), jnp.float32)
+
+    def qloss(x, w):
+        return (quantized_matmul(x, w, "int8") * ct).sum()
+
+    def loss(x, w):
+        return ((x @ w) * ct).sum()
+
+    gq = jax.grad(qloss, argnums=(0, 1))(x, w)
+    gp = jax.grad(loss, argnums=(0, 1))(x, w)
+    for a, b in zip(gq, gp):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_int8_contraction_is_integer_exact():
+    """int8 x int8 rides the integer unit: for inputs that ARE exact
+    int8 grids, the quantized matmul reproduces the fp32 product bit-for
+    -bit (int32 accumulation has no rounding) — the property that makes
+    the MXU's 8-bit path trustworthy, not just fast."""
+    rng = np.random.default_rng(4)
+    xq = rng.integers(-127, 128, size=(8, 16)).astype(np.float32)
+    wq = rng.integers(-127, 128, size=(16, 4)).astype(np.float32)
+    x = jnp.asarray(xq * 0.5)  # exact scales: amax maps back exactly
+    w = jnp.asarray(wq * 0.25)
+    # Force every amax onto the grid end so quantize() reproduces the
+    # grid exactly (w scales are per-channel: every column needs its
+    # amax anchored, not just one).
+    x = x.at[0, 0].set(127 * 0.5)
+    w = w.at[0, :].set(127 * 0.25)
+    ref = np.asarray(x) @ np.asarray(w)
+    out = np.asarray(quantized_matmul(x, w, "int8"))
+    np.testing.assert_allclose(out, ref, rtol=1e-6)

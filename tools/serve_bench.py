@@ -1,18 +1,27 @@
 #!/usr/bin/env python
 """Serving throughput/latency A/B: dense-decode vs flash-decode, replicated
-vs model-sharded KV cache, through the continuous-batching engine.
+vs model-sharded KV cache — and bf16/fp32 vs int8-quantized KV cache —
+through the continuous-batching engine.
 
 Runs end-to-end on CPU simulation (the sim devices come from
 ``--sim-devices``, set BEFORE jax initializes) so the whole pipeline —
 bucketed prefill, slot grafts, decode steps, eos retirement — is exercised
 without hardware; the on-chip capture at the real operating point is the
 queued A/B (BACKLOG R8-1). Measures tokens/sec and p50/p99 per-token
-latency per arm and emits one BENCH_TABLE-schema row per arm (printed as a
-JSON line; ``--out`` appends to a file). CPU-sim rows are diagnostics —
-only on-chip rows get committed to BENCH_TABLE.jsonl.
+latency per arm — plus the CAPACITY columns the quantized cache is for:
+``hbm_bytes_per_slot`` (actual engine cache, scale tensors included —
+``generation.cache_bytes_per_slot``), a bf16-cache reference at the same
+bucket, and ``max_slots_at_hbm`` under ``--hbm-gb`` of cache budget — and
+emits one BENCH_TABLE-schema row per arm (printed as a JSON line;
+``--out`` appends to a file). CPU-sim rows are diagnostics — only on-chip
+rows get committed to BENCH_TABLE.jsonl.
+
+Arms are ``{dense|flash}_{replicated|sharded}[_int8|_fp8]``; the
+``_int8`` suffix serves the same workload with
+``model.kv_cache_quant=int8`` (``_fp8`` maps to ``fp8_e4m3``).
 
     python tools/serve_bench.py --preset tiny --requests 12 --slots 4
-    python tools/serve_bench.py --preset tiny --arms dense_replicated,flash_sharded
+    python tools/serve_bench.py --preset tiny --arms flash_sharded,flash_sharded_int8
 """
 
 from __future__ import annotations
@@ -36,10 +45,15 @@ def _parse_args(argv=None):
     p.add_argument("--sim-devices", type=int, default=8,
                    help="CPU-sim device count (0 = leave backend alone)")
     p.add_argument("--arms", default="dense_replicated,flash_replicated,"
-                   "dense_sharded,flash_sharded",
-                   help="comma-separated: {dense,flash}_{replicated,sharded}")
+                   "dense_sharded,flash_sharded,flash_replicated_int8,"
+                   "flash_sharded_int8",
+                   help="comma-separated: "
+                   "{dense,flash}_{replicated,sharded}[_int8|_fp8]")
     p.add_argument("--model-axis", type=int, default=2,
                    help="model-axis size for the sharded arms")
+    p.add_argument("--hbm-gb", type=float, default=16.0,
+                   help="per-replica KV-cache HBM budget for the "
+                   "max-concurrent-slots column")
     p.add_argument("--out", default=None,
                    help="append emitted rows to this jsonl file")
     return p.parse_args(argv)
@@ -73,8 +87,12 @@ def _build(preset: str):
     from frl_distributed_ml_scaffold_tpu.precision import get_policy
 
     if preset == "tiny":
+        # heads=2 (head_dim 32): CPU-sim friendly while keeping the
+        # head_dim representative enough that the int8 arms' bytes-per-
+        # slot accounting reflects real geometry (scale overhead is
+        # 2/head_dim of the payload — at head_dim 8 it would dominate).
         cfg = GPTConfig(
-            vocab_size=256, num_layers=2, num_heads=4, hidden_dim=64,
+            vocab_size=256, num_layers=2, num_heads=2, hidden_dim=64,
             seq_len=256, dropout=0.0,
         )
     else:
@@ -160,8 +178,20 @@ def run_arm(model, params, arm: str, args, flops_per_token: int) -> dict:
     )
     from frl_distributed_ml_scaffold_tpu.serving import ServingEngine
 
-    impl, sharding = arm.split("_")
-    m = dataclasses.replace(model.config, decode_attention=impl)
+    parts = arm.split("_")
+    if len(parts) == 2:
+        (impl, sharding), quant = parts, "none"
+    elif len(parts) == 3 and parts[2] in ("int8", "fp8"):
+        impl, sharding = parts[:2]
+        quant = {"int8": "int8", "fp8": "fp8_e4m3"}[parts[2]]
+    else:
+        raise ValueError(
+            f"unknown arm {arm!r}: want "
+            "{dense,flash}_{replicated,sharded}[_int8|_fp8]"
+        )
+    m = dataclasses.replace(
+        model.config, decode_attention=impl, kv_cache_quant=quant
+    )
     model = GPT(m, model.policy)
 
     mesh_sizes = {"pipe": 1, "data": 1, "fsdp": 1, "seq": 1, "expert": 1,
@@ -208,6 +238,20 @@ def run_arm(model, params, arm: str, args, flops_per_token: int) -> dict:
         wall = time.perf_counter() - t0
     assert len(done) == len(work), (len(done), len(work))
 
+    # Capacity accounting (the quantized-cache arms' raison d'être):
+    # actual per-slot bytes of the terminal-bucket engine cache (scale
+    # tensors included) vs a bf16-cache reference at the SAME bucket, and
+    # the concurrent slots each fits in the --hbm-gb cache budget.
+    from frl_distributed_ml_scaffold_tpu.models.generation import (
+        estimate_cache_bytes_per_slot,
+    )
+
+    bytes_per_slot = eng.bytes_per_slot()
+    bf16_cfg = dataclasses.replace(model.config, kv_cache_quant="none")
+    bytes_bf16_ref = estimate_cache_bytes_per_slot(
+        bf16_cfg, eng.bucket, kv_dtype_bytes=2
+    )
+    hbm_budget = int(args.hbm_gb * (1 << 30))
     lat = np.asarray(
         [dt for c in done for dt in c.token_latencies_s], np.float64
     )
@@ -237,11 +281,18 @@ def run_arm(model, params, arm: str, args, flops_per_token: int) -> dict:
             "arm": arm,
             "decode_attention": impl,
             "kv_cache_sharding": sharding,
+            "kv_cache_quant": quant,
             "tokens_per_sec": round(tok_per_sec, 3),
             "latency_p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 3),
             "latency_p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 3),
             "requests": len(work),
             "slots": args.slots,
+            "cache_bucket": eng.bucket,
+            "hbm_bytes_per_slot": bytes_per_slot,
+            "bytes_per_slot_bf16_ref": bytes_bf16_ref,
+            "max_slots_at_hbm": hbm_budget // max(bytes_per_slot, 1),
+            "max_slots_at_hbm_bf16_ref": hbm_budget // max(bytes_bf16_ref, 1),
+            "hbm_budget_gb": args.hbm_gb,
             "engine_stats": dict(eng.stats),
         },
         "note": (
@@ -284,9 +335,11 @@ def main(argv=None) -> int:
     for row in rows:
         s = row["serving"]
         print(
-            f"# {s['arm']:>18s}: {s['tokens_per_sec']:9.1f} tok/s  "
+            f"# {s['arm']:>23s}: {s['tokens_per_sec']:9.1f} tok/s  "
             f"p50 {s['latency_p50_ms']:7.2f} ms  "
-            f"p99 {s['latency_p99_ms']:7.2f} ms",
+            f"p99 {s['latency_p99_ms']:7.2f} ms  "
+            f"{s['hbm_bytes_per_slot']:>9d} B/slot  "
+            f"{s['max_slots_at_hbm']:>8d} slots@{s['hbm_budget_gb']:g}G",
             file=sys.stderr,
         )
     return 0
