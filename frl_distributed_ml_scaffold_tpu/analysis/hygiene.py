@@ -22,6 +22,15 @@ Static Python-source checks for the bug classes that only bite under
   function.  Often legal shape-time arithmetic (``np.prod(shape)``), so
   an allowlist of shape-time helpers keeps this quiet; the rest is worth
   a look — on a tracer it either crashes or silently constant-folds.
+- **metrics-in-traced** (error): a telemetry mutation (``.inc()`` /
+  ``.observe()`` / a non-``.at[...]`` ``.set(v)`` / a
+  ``registry.counter|gauge|histogram(...)`` lookup / anything reached
+  through a ``telemetry`` attribute) inside traced code.  The telemetry
+  layer's contract (ISSUE 7, the veScale single-controller argument) is
+  HOST-SIDE ONLY: inside a trace a metric mutation either runs once at
+  trace time and silently freezes, or drags a host sync into every
+  step — both defeat the metric.  ``x.at[idx].set(v)`` is the jnp
+  functional update and stays exempt (the receiver is a subscript).
 
 "Traced function" is approximated as: a function whose body references
 ``jnp.`` / ``jax.lax`` / ``lax.`` — exactly the modules the repo's traced
@@ -59,6 +68,45 @@ _NP_SHAPE_TIME = {
 
 _HOST_SYNC_CALLS = {"device_get", "block_until_ready"}
 _NP_HOST_SYNC = {"asarray", "array"}
+
+# Telemetry mutators/constructors (telemetry/metrics.py). ``set`` is
+# handled separately: only non-subscript receivers count (x.at[i].set is
+# the jnp functional update, not a gauge).
+_METRIC_MUTATORS = {"inc", "observe"}
+_METRIC_FACTORIES = {"counter", "gauge", "histogram"}
+
+
+# Array/stdlib modules whose methods legitimately collide with metric
+# names (jnp.histogram, np.histogram, jax.numpy.histogram): never metric
+# receivers. Chained-call receivers (reg.counter("x").inc()) dotted to ''
+# stay flagged.
+_ARRAY_MODULE_ROOTS = {"jnp", "np", "numpy", "jax", "lax", "scipy"}
+
+
+def _is_metric_call(node: ast.Call, name: str) -> bool:
+    """A telemetry mutation/lookup (see module docstring) — only
+    meaningful inside traced code."""
+    if "telemetry" in name.split("."):
+        return True
+    if not isinstance(node.func, ast.Attribute):
+        return False
+    recv = _dotted(node.func.value)
+    if recv and recv.split(".")[0] in _ARRAY_MODULE_ROOTS:
+        return False
+    # node.func.attr, not the dotted-name leaf: chained calls like
+    # reg.counter("x").inc() have a Call receiver, where _dotted gives ''.
+    attr = node.func.attr
+    if attr in _METRIC_MUTATORS or attr in _METRIC_FACTORIES:
+        return True
+    if (
+        attr == "set"
+        and not isinstance(node.func.value, ast.Subscript)
+        and len(node.args) == 1
+    ):
+        # gauge.set(v): exactly one arg, plain receiver. x.at[i].set(v)
+        # has a Subscript receiver; threading's Event.set() has no args.
+        return True
+    return False
 
 
 def _dotted(node: ast.AST) -> str:
@@ -162,6 +210,20 @@ def lint_source(
                         )
                     )
             if not traced:
+                continue
+            if _is_metric_call(node, name):
+                findings.append(
+                    Finding(
+                        "hygiene", "error", "metrics-in-traced",
+                        f"{filename}:{node.lineno} function {fn.name!r} "
+                        f"mutates a telemetry metric ({name or leaf}()) "
+                        "inside traced code — metrics are host-side only "
+                        "(trace-time freeze or a per-step host sync); "
+                        "record around the jitted call instead",
+                        {**where(node), "call": name or leaf,
+                         "function": fn.name},
+                    )
+                )
                 continue
             if name.startswith(("random.", "np.random.", "numpy.random.")):
                 findings.append(

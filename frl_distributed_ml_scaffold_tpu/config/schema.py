@@ -184,6 +184,13 @@ class TrainerConfig:
     # the profiler traces. JSONL remains the record of truth; the sink is
     # lazy-TF and degrades to a warning if TF is unusable.
     tensorboard: bool = False
+    # Stall watchdog deadline (ISSUE 7): a host thread fires when no step
+    # completes dispatch within this many seconds — faulthandler
+    # tracebacks + metric snapshot to <run_dir>/stall_dump.txt and a
+    # stalls_total counter increment. 0 = off. Size it to several times
+    # the slowest expected step INCLUDING the initial compile (the first
+    # beats land only after dispatch starts flowing).
+    stall_timeout_s: float = 0.0
     # Keep the optimizer state in host memory (``pinned_host``): XLA
     # streams it through HBM around the update. A CAPACITY knob, not a
     # speed knob — it pays PCIe traffic every optimizer step to free

@@ -112,7 +112,7 @@ class _Membership:
     for humans/debugging only.
     """
 
-    def __init__(self, run_dir: str, uid: int, endpoint: str):
+    def __init__(self, run_dir: str, uid: int, endpoint: str, registry=None):
         self.dir = os.path.join(run_dir, "members")
         self.uid = uid
         self.endpoint = endpoint
@@ -123,6 +123,18 @@ class _Membership:
         # thread) call beat(); serialise them so the shared tmp file can't
         # interleave two writers and publish torn JSON.
         self._beat_lock = threading.Lock()
+        # Telemetry (ISSUE 7): the oldest heartbeat age observed at the
+        # last liveness read — the scrape-able early warning that a peer
+        # is drifting toward the peer_timeout_s eviction line.
+        self._m_hb_age = (
+            registry.gauge(
+                "elastic_heartbeat_age_s",
+                help="oldest live member heartbeat age at the last "
+                     "liveness read (evicted peers excluded)",
+            )
+            if registry is not None
+            else None
+        )
 
     def beat(self) -> None:
         with self._beat_lock:
@@ -209,6 +221,7 @@ class _Membership:
             )
             return None
         out = []
+        max_age = 0.0
         try:
             names = os.listdir(self.dir)
         except OSError as e:
@@ -235,6 +248,11 @@ class _Membership:
                 return None
             if now - mtime > peer_timeout_s:
                 continue  # genuinely stale: dead
+            # Gauge folds LIVE members only: a hard-crashed peer's file is
+            # never unlinked (only clean retire() does that), and its
+            # ever-growing age would saturate the gauge forever, masking
+            # the live-member lag this metric exists to warn about.
+            max_age = max(max_age, now - mtime)
             try:
                 with open(path) as fh:
                     rec = json.load(fh)
@@ -248,6 +266,8 @@ class _Membership:
                 )
                 return None
             out.append(rec)
+        if self._m_hb_age is not None:
+            self._m_hb_age.set(max_age)
         return sorted(out, key=lambda r: r["uid"])
 
 
@@ -318,6 +338,37 @@ def supervise(args, cfg: ExperimentConfig) -> int:
     env = os.environ.copy()
     env["PYTHONPATH"] = _REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
 
+    # Supervisor telemetry (ISSUE 7): restart/shrink/grow counters + the
+    # membership heartbeat-age gauge, published as a Prometheus sidecar
+    # next to the child's run artifacts on every supervision event — the
+    # fleet-level "is this host crash-looping / shrunk" signal.
+    from frl_distributed_ml_scaffold_tpu.telemetry import (
+        MetricsRegistry,
+        write_prometheus_file,
+    )
+
+    telem = MetricsRegistry()
+    m_restarts = telem.counter(
+        "elastic_restarts_total", help="child restarts under supervision"
+    )
+    m_reforms = telem.counter(
+        "elastic_membership_changes_total",
+        help="committed topology re-formations (shrinks + grows)",
+    )
+    m_shrinks = telem.counter("elastic_shrinks_total")
+    m_grows = telem.counter("elastic_grows_total")
+    m_world = telem.gauge("elastic_world_size")
+    run_dir_t = os.path.join(cfg.workdir, cfg.name)
+
+    def export_telemetry() -> None:
+        try:
+            os.makedirs(run_dir_t, exist_ok=True)
+            write_prometheus_file(
+                telem, os.path.join(run_dir_t, f"supervisor_{uid or 0}.prom")
+            )
+        except OSError as e:  # shared-FS blip: telemetry never kills a run
+            logger.warning("elastic: telemetry export failed (%s)", e)
+
     world = args.num_processes if args.num_processes is not None else 1
     uid = args.process_id
     topo: dict = {}
@@ -343,11 +394,13 @@ def supervise(args, cfg: ExperimentConfig) -> int:
         else:
             endpoint, held_port = _own_endpoint(args)
             membership = _Membership(
-                os.path.join(cfg.workdir, cfg.name), uid, endpoint
+                os.path.join(cfg.workdir, cfg.name), uid, endpoint,
+                registry=telem,
             )
             membership.start(interval_s=heartbeat_interval)
 
     initial_world = world
+    m_world.set(world)
     restarts = 0
     consecutive_failures = 0
     #: Budget-free restarts granted after a grow commit: a partially
@@ -401,6 +454,10 @@ def supervise(args, cfg: ExperimentConfig) -> int:
             reason, world, new_world, new_rank, new_coord,
         )
         world = new_world
+        m_reforms.inc()
+        (m_grows if reason == "growing" else m_shrinks).inc()
+        m_world.set(new_world)
+        export_telemetry()
         topo = {
             "num_processes": new_world,
             "process_id": new_rank,
@@ -546,6 +603,8 @@ def supervise(args, cfg: ExperimentConfig) -> int:
                 )
                 return rc
             restarts += 1
+            m_restarts.inc()
+            export_telemetry()
             delay = cfg.elastic.backoff_s * (2 ** (restarts - 1))
             logger.warning(
                 "elastic: child died rc=%d after %.1fs; restart %d/%d in "

@@ -52,6 +52,12 @@ from frl_distributed_ml_scaffold_tpu.models.generation import (
     cache_capacity_axis,
     next_cache_bucket,
 )
+from frl_distributed_ml_scaffold_tpu.telemetry import (
+    Histogram,
+    MetricsRegistry,
+    StallWatchdog,
+    Timeline,
+)
 
 
 @dataclasses.dataclass
@@ -66,13 +72,44 @@ class ServeRequest:
 @dataclasses.dataclass
 class Completion:
     """A finished request: prompt + generated tokens and per-token wall
-    latencies (the decode steps this request was live for)."""
+    latencies (the decode steps this request was live for), plus the
+    serving-SLO summary of those latencies: ``ttft_s`` (time to first
+    token — the prefill) and p50/p99 time-per-output-token over the
+    decode steps, computed through the telemetry histogram's log2-bucket
+    quantile estimator so per-request numbers and the engine's aggregate
+    ``serve_tpot_seconds`` histogram read on the same scale."""
 
     id: int
     tokens: np.ndarray  # [prompt_len + n_generated]
     prompt_len: int
     finish_reason: str  # "eos" | "length"
     token_latencies_s: list[float]
+    ttft_s: float = 0.0
+    tpot_p50_s: float = 0.0
+    tpot_p99_s: float = 0.0
+
+
+def _log2_quantiles(vals, qs) -> list[float]:
+    """Quantiles of ``vals`` through a detached log2-bucket Histogram —
+    the same estimator (and thus the same 2x-granularity scale) as the
+    engine's aggregate latency histograms."""
+    h = Histogram(MetricsRegistry(), "q", help="")
+    for v in vals:
+        h.observe(v)
+    return [h.quantile(q) for q in qs]
+
+
+def _hbm_gib() -> dict[str, float]:
+    """In-use/peak HBM GiB (empty on backends without memory stats)."""
+    from frl_distributed_ml_scaffold_tpu.utils.profiling import (
+        device_memory_stats,
+    )
+
+    stats = device_memory_stats()
+    return {
+        k: v for k, v in stats.items()
+        if k in ("hbm_in_use_gib", "hbm_peak_gib")
+    }
 
 
 class ServingEngine:
@@ -97,6 +134,9 @@ class ServingEngine:
         top_p: float = 0.0,
         rng: jax.Array | None = None,
         min_bucket: int = 8,
+        telemetry: MetricsRegistry | None = None,
+        stall_timeout_s: float = 0.0,
+        stall_dump_path: str | None = None,
     ):
         model, params = _plain_stack(model, params)
         self.model, self.params = model, params
@@ -140,6 +180,56 @@ class ServingEngine:
         self._grow_jit: dict[tuple[int, int], Any] = {}
         # Observability: how often each compiled-shape class actually ran.
         self.stats = collections.Counter()
+        # Telemetry (ISSUE 7): every metric is registered up front so both
+        # exporters always carry the full serving catalog (a gauge that
+        # never fired still scrapes as 0, which is itself a signal). All
+        # host-side, around the jitted programs — never inside them
+        # (graft-lint `metrics-in-traced` enforces this).
+        self.telemetry = telemetry if telemetry is not None else MetricsRegistry()
+        self.timeline = Timeline(enabled=self.telemetry.enabled)
+        t = self.telemetry
+        self._m_ttft = t.histogram(
+            "serve_ttft_seconds", help="time to first token (prefill+graft)"
+        )
+        self._m_tpot = t.histogram(
+            "serve_tpot_seconds",
+            help="per-output-token latency over live slots (decode steps)",
+        )
+        self._m_queue = t.gauge("serve_queue_depth", help="requests waiting")
+        self._m_occupancy = t.gauge(
+            "serve_slot_occupancy", help="active slots / num_slots"
+        )
+        self._m_bytes_slot = t.gauge(
+            "serve_bytes_per_slot",
+            help="per-slot HBM of the live cache at its current bucket",
+        )
+        self._m_hbm_used = t.gauge(
+            "serve_hbm_in_use_gib", help="device HBM in use (0 when the "
+            "backend exposes no stats, e.g. CPU sim)"
+        )
+        self._m_hbm_peak = t.gauge(
+            "serve_hbm_peak_gib", help="device HBM high-watermark"
+        )
+        self._m_prefills = t.counter("serve_prefill_total", help="prefills run")
+        self._m_decodes = t.counter(
+            "serve_decode_steps_total", help="slot-array decode iterations"
+        )
+        self._m_grows = t.counter(
+            "serve_bucket_grow_total", help="cache bucket growths"
+        )
+        self._m_grafts = t.counter(
+            "serve_cache_graft_total", help="prefill-cache grafts into slots"
+        )
+        self._m_completed = t.counter(
+            "serve_completed_total", help="requests finished"
+        )
+        self.watchdog = StallWatchdog(
+            stall_timeout_s,
+            name="serve",
+            registry=t,
+            timeline=self.timeline,
+            dump_path=stall_dump_path,
+        )
 
     # ----------------------------------------------------------- frontend
 
@@ -187,6 +277,10 @@ class ServingEngine:
         self.cache = None
         self.bucket = 0
         self.stats.clear()
+        # The warm pass's observations include compile time — drop them
+        # so the measured pass's histograms report serving, not XLA.
+        self.telemetry.reset()
+        self.timeline.drain()
 
     def bytes_per_slot(self) -> int:
         """Per-slot HBM of the LIVE engine cache at its current bucket —
@@ -198,6 +292,10 @@ class ServingEngine:
         if self.cache is None:
             return 0
         return cache_bytes_per_slot(self.cache, self.num_slots)
+
+    def close(self) -> None:
+        """Stop the watchdog thread (daemon — leak-safe either way)."""
+        self.watchdog.stop()
 
     def run(self, max_steps: int | None = None) -> list[Completion]:
         """Drain the queue; returns completions in finish order."""
@@ -329,7 +427,12 @@ class ServingEngine:
         if target > self.bucket:
             self.cache = self._grow_fn(self.bucket, target)(self.cache)
             self.stats[f"grow_{self.bucket}->{target}"] += 1
+            self._m_grows.inc()
+            self.timeline.event(
+                "bucket_grow", frm=self.bucket, to=target
+            )
             self.bucket = target
+            self._m_bytes_slot.set(self.bytes_per_slot())
 
     def _admit(self) -> None:
         for slot in range(self.num_slots):
@@ -359,6 +462,17 @@ class ServingEngine:
             tok = int(jax.device_get(tok)[0])
             dt = time.perf_counter() - t0
             self.stats[f"prefill_{s_p}"] += 1
+            # TTFT = submit-to-first-token work this engine performed for
+            # the request: prefill + graft + the forced first-token fetch.
+            # (Queue wait is visible separately via serve_queue_depth.)
+            self._m_ttft.observe(dt)
+            self._m_prefills.inc()
+            self._m_grafts.inc()
+            self._m_bytes_slot.set(self.bytes_per_slot())
+            self.timeline.event(
+                "prefill", dur_s=dt, slot=slot, bucket=s_p, request=req.id
+            )
+            self.watchdog.beat()
 
             self._req[slot] = req
             self._tokens[slot] = [tok]
@@ -382,6 +496,11 @@ class ServingEngine:
 
     def _retire(self, slot: int, reason: str) -> None:
         req = self._req[slot]
+        lat = self._latency[slot]
+        # Per-request SLO columns, through the same log2-bucket estimator
+        # the aggregate serve_tpot_seconds histogram uses: ttft is the
+        # prefill latency (lat[0]); tpot covers the decode steps (lat[1:]).
+        tpot = _log2_quantiles(lat[1:], (0.50, 0.99))
         comp = Completion(
             id=req.id,
             tokens=np.concatenate(
@@ -389,13 +508,21 @@ class ServingEngine:
             ),
             prompt_len=int(req.prompt.size),
             finish_reason=reason,
-            token_latencies_s=self._latency[slot],
+            token_latencies_s=lat,
+            ttft_s=lat[0] if lat else 0.0,
+            tpot_p50_s=tpot[0],
+            tpot_p99_s=tpot[1],
         )
         self._completed.append(comp)
         self._req[slot] = None
         self._active[slot] = False
         self.stats["completed"] += 1
         self.stats[f"finish_{reason}"] += 1
+        self._m_completed.inc()
+        self.timeline.event(
+            "retire", slot=slot, request=req.id, reason=reason,
+            n_tokens=len(self._tokens[slot]),
+        )
 
     # --------------------------------------------------------------- step
 
@@ -404,7 +531,9 @@ class ServingEngine:
         array, retire finished rows. Returns requests completed during
         this step (possibly at admission, for 1-token budgets)."""
         self._completed: list[Completion] = []
+        self._m_queue.set(len(self._queue))
         self._admit()
+        self._m_occupancy.set(float(self._active.sum()) / self.num_slots)
         if not self._active.any():
             return self._completed
 
@@ -427,6 +556,19 @@ class ServingEngine:
         dt = time.perf_counter() - t0
         self.stats[f"decode_{self.bucket}"] += 1
         self.stats["decode_steps"] += 1
+        self._m_decodes.inc()
+        self.timeline.event(
+            "decode", dur_s=dt, bucket=self.bucket,
+            active=int(self._active.sum()),
+        )
+        self.watchdog.beat()
+        if self.telemetry.enabled:
+            # memory_stats() is a per-device PJRT runtime call — real cost
+            # on a ~ms decode step, so the disabled path must skip the
+            # query itself, not just the no-op gauge write.
+            for k, v in _hbm_gib().items():
+                (self._m_hbm_used if k == "hbm_in_use_gib"
+                 else self._m_hbm_peak).set(v)
 
         for slot in range(self.num_slots):
             if not self._active[slot]:
@@ -435,6 +577,7 @@ class ServingEngine:
             self._tokens[slot].append(tok)
             self._len[slot] += 1
             self._latency[slot].append(dt)
+            self._m_tpot.observe(dt)
             self._last_tok[slot] = tok
             self._finishes(slot, tok)
         return self._completed

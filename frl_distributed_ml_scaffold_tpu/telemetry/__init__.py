@@ -1,0 +1,30 @@
+"""Runtime telemetry: one metrics/tracing layer across the train, serve,
+and elastic tiers (ISSUE 7). See metrics.py for the design contract; the
+graft-lint hygiene pass enforces the host-side-only rule (no metric
+mutation inside traced code)."""
+
+from frl_distributed_ml_scaffold_tpu.telemetry.metrics import (
+    LOG2_LATENCY_BUCKETS_S,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    jsonl_record,
+    prometheus_text,
+    write_prometheus_file,
+)
+from frl_distributed_ml_scaffold_tpu.telemetry.timeline import Timeline
+from frl_distributed_ml_scaffold_tpu.telemetry.watchdog import StallWatchdog
+
+__all__ = [
+    "LOG2_LATENCY_BUCKETS_S",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "StallWatchdog",
+    "Timeline",
+    "jsonl_record",
+    "prometheus_text",
+    "write_prometheus_file",
+]

@@ -1,0 +1,146 @@
+"""Stall watchdog: a host thread that notices when progress stops.
+
+The failure mode it exists for: a deadlocked collective, a hung host
+callback, or a wedged data loader leaves the process ALIVE but the
+step/decode loop silent — the logs just stop, and on a fleet that reads
+as "no news". The watchdog turns silence into a report: if no ``beat()``
+lands within ``timeout_s`` it
+
+1. increments the registry's ``stalls_total`` counter (the alarmable
+   signal — a scrape sees it even if the dump is unreachable),
+2. appends a dump to ``dump_path``: ``faulthandler`` tracebacks of every
+   thread (where is the loop actually stuck?), the live metric snapshot,
+   and the timeline tail (what last completed), and
+3. logs an ERROR through the framework logger.
+
+It then stays quiet until the NEXT beat re-arms it — one report per
+silence, not one per poll. ``beat()`` is a single monotonic-clock store,
+cheap enough for per-step (or per-decode-step) calls; the watchdog never
+touches device state, so it cannot itself deadlock on the thing it is
+diagnosing.
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import json
+import threading
+import time
+from typing import Any
+
+from frl_distributed_ml_scaffold_tpu.utils.logging import get_logger
+
+
+class StallWatchdog:
+    """Fire when no ``beat()`` arrives within ``timeout_s`` (see module
+    docstring). ``timeout_s <= 0`` constructs a disabled no-op watchdog
+    (no thread), so callers can wire it unconditionally."""
+
+    def __init__(
+        self,
+        timeout_s: float,
+        *,
+        name: str = "train",
+        registry: Any | None = None,
+        timeline: Any | None = None,
+        dump_path: str | None = None,
+        poll_s: float | None = None,
+    ):
+        self.timeout_s = float(timeout_s)
+        self.name = name
+        self._registry = registry
+        self._timeline = timeline
+        self._dump_path = dump_path
+        self._counter = (
+            registry.counter(
+                "stalls_total",
+                help="watchdog firings: no progress within the deadline",
+            )
+            if registry is not None
+            else None
+        )
+        self._last = time.monotonic()
+        self._armed = True  # one report per silence window
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        if self.timeout_s > 0:
+            poll = poll_s if poll_s is not None else max(self.timeout_s / 4, 0.25)
+            self._thread = threading.Thread(
+                target=self._loop,
+                args=(max(poll, 0.005),),
+                name=f"stall-watchdog-{name}",
+                daemon=True,
+            )
+            self._thread.start()
+
+    @property
+    def enabled(self) -> bool:
+        return self._thread is not None
+
+    def beat(self) -> None:
+        """Progress landed; re-arm. Host-side store only — never call
+        from traced code (graft-lint hygiene enforces the same for the
+        metric mutations this class makes)."""
+        self._last = time.monotonic()
+        self._armed = True
+
+    @property
+    def fired(self) -> int:
+        return int(self._counter.value) if self._counter is not None else 0
+
+    def _loop(self, poll: float) -> None:
+        while not self._stop.wait(poll):
+            # Read _armed BEFORE _last — the mirror of beat()'s
+            # _last-then-_armed write order. Reading them the other way
+            # around can pair a stale _last with a freshly-set _armed and
+            # fire a spurious "stall" right after progress resumed.
+            armed = self._armed
+            silent = time.monotonic() - self._last
+            if armed and silent > self.timeout_s:
+                self._armed = False  # quiet until the next beat
+                try:
+                    self._fire(silent)
+                except Exception as e:  # the reporter must never kill a run
+                    get_logger().warning(
+                        "watchdog[%s]: stall report failed (%s)", self.name, e
+                    )
+
+    def _fire(self, silent_s: float) -> None:
+        if self._counter is not None:
+            self._counter.inc()
+        get_logger().error(
+            "watchdog[%s]: no progress for %.1fs (deadline %.1fs)%s",
+            self.name,
+            silent_s,
+            self.timeout_s,
+            f" — dumping to {self._dump_path}" if self._dump_path else "",
+        )
+        if self._dump_path is None:
+            return
+        with open(self._dump_path, "a") as fh:
+            fh.write(
+                f"=== watchdog[{self.name}] stall at {time.time():.3f}: "
+                f"no progress for {silent_s:.1f}s ===\n"
+            )
+            faulthandler.dump_traceback(file=fh, all_threads=True)
+            if self._registry is not None:
+                fh.write("\n--- metric snapshot ---\n")
+                fh.write(json.dumps(self._registry.snapshot(), indent=1))
+                fh.write("\n")
+            if self._timeline is not None:
+                fh.write("--- timeline tail ---\n")
+                for rec in self._timeline.tail():
+                    fh.write(json.dumps(rec) + "\n")
+            fh.flush()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def __enter__(self) -> "StallWatchdog":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.stop()

@@ -552,6 +552,59 @@ class Trainer:
             device_memory_stats,
         )
 
+        # Telemetry (ISSUE 7): one registry per fit() run, exported at
+        # every log boundary as a JSONL snapshot record (telemetry.jsonl,
+        # next to the metrics.jsonl record of truth) and an atomic
+        # Prometheus sidecar file (metrics.prom — textfile-collector
+        # shape). The per-step timeline (load_batch/dispatch phases)
+        # ring-buffers between boundaries and drains into the same JSONL.
+        from frl_distributed_ml_scaffold_tpu.telemetry import (
+            MetricsRegistry,
+            StallWatchdog,
+            Timeline,
+            jsonl_record,
+            write_prometheus_file,
+        )
+        from frl_distributed_ml_scaffold_tpu.utils.logging import JsonlWriter
+        from frl_distributed_ml_scaffold_tpu.utils.flops import (
+            peak_flops_per_chip,
+        )
+
+        telem = MetricsRegistry()
+        timeline = Timeline()
+        telemetry_jsonl = JsonlWriter(os.path.join(run_dir, "telemetry.jsonl"))
+        prom_path = os.path.join(run_dir, "metrics.prom")
+        m_step = telem.histogram(
+            "train_step_seconds",
+            help="per-step e2e wall time (window average, post-warmup)",
+        )
+        m_wait = telem.histogram(
+            "train_data_wait_seconds",
+            help="host wait for the next batch, per step",
+        )
+        m_sps = telem.gauge(
+            "train_samples_per_sec_per_chip", help="the north-star metric"
+        )
+        m_mfu = telem.gauge("train_mfu", help="model FLOPs / chip peak")
+        m_wait_frac = telem.gauge(
+            "train_data_wait_fraction",
+            help="data-wait share of the step (input-bound when near 1)",
+        )
+        m_hbm_used = telem.gauge("train_hbm_in_use_gib")
+        m_hbm_peak = telem.gauge(
+            "train_hbm_peak_gib", help="HBM high-watermark per log window"
+        )
+        m_steps = telem.counter("train_steps_total")
+        watchdog = StallWatchdog(
+            cfg.trainer.stall_timeout_s,
+            name=cfg.name,
+            registry=telem,
+            timeline=timeline,
+            dump_path=os.path.join(run_dir, "stall_dump.txt"),
+        )
+        flops_per_step: float | None = None  # lazy; False once probing failed
+        window_wait = 0.0
+
         profiler = WindowProfiler(
             os.path.join(run_dir, "trace"),
             start_step=start_step + cfg.trainer.profile_start_step,
@@ -577,26 +630,84 @@ class Trainer:
                 prev_handlers[_sig] = _signal.signal(_sig, _graceful)
 
         try:
+            import time as _time
+
             for step in range(start_step, total):
                 profiler.step_start(step)
+                t_load = _time.perf_counter()
                 with annotate("load_batch"):
                     batch = self.pipeline.global_batch(step)
+                data_wait = _time.perf_counter() - t_load
+                window_wait += data_wait
+                m_wait.observe(data_wait)
+                timeline.event("load_batch", dur_s=data_wait, step=step)
+                t_disp = _time.perf_counter()
                 with annotate_step(step):
                     state, metrics = self.train_step(state, batch)
+                timeline.event(
+                    "dispatch", dur_s=_time.perf_counter() - t_disp, step=step
+                )
+                watchdog.beat()
                 if (step + 1) % cfg.trainer.log_every == 0 or step + 1 == total:
-                    timer.tick_window(metrics["loss"], step + 1 - last_logged)
+                    win_steps = step + 1 - last_logged
+                    dt = timer.tick_window(metrics["loss"], win_steps)
                     last_logged = step + 1
                     perf = timer.summary(samples_per_step)
+                    # Step split: the host waits data_wait for the batch;
+                    # the rest of the e2e step is device compute (the loop
+                    # only blocks at this boundary, so the split is
+                    # window-averaged — the veScale host-side discipline).
+                    avg_wait = window_wait / max(win_steps, 1)
+                    window_wait = 0.0
+                    mem = device_memory_stats()
                     extra = {
                         "lr": float(self.schedule(step)),
                         **{
                             k: round(v, 6)
                             for k, v in perf.items()
-                            if k in ("step_time_median_s", "samples_per_sec_per_chip")
+                            if k in (
+                                "step_time_median_s",
+                                "step_time_p50_s",
+                                "step_time_p95_s",
+                                "step_time_p99_s",
+                                "samples_per_sec_per_chip",
+                            )
                         },
-                        **device_memory_stats(),
+                        "data_wait_s": round(avg_wait, 6),
+                        **mem,
                     }
+                    if dt is not None:
+                        m_step.observe(dt)
+                        extra["compute_s"] = round(max(dt - avg_wait, 0.0), 6)
+                        m_wait_frac.set(min(avg_wait / max(dt, 1e-12), 1.0))
+                        # MFU: probe step FLOPs once, lazily, and only
+                        # after the warmup window (single-boundary test
+                        # fits never pay the AOT lower it costs).
+                        if flops_per_step is None:
+                            try:
+                                cost = self.step_cost_analysis(state, batch)
+                                flops_per_step = (
+                                    float(cost["flops"]) if cost else False
+                                )
+                            except Exception:
+                                flops_per_step = False
+                    med = perf.get("step_time_median_s", 0.0)
+                    if flops_per_step and med > 0:
+                        mfu = flops_per_step / (
+                            med * jax.device_count() * peak_flops_per_chip()
+                        )
+                        extra["mfu"] = mfu
+                        m_mfu.set(mfu)
+                    m_sps.set(perf.get("samples_per_sec_per_chip", 0.0))
+                    m_hbm_used.set(mem.get("hbm_in_use_gib", 0.0))
+                    m_hbm_peak.set(mem.get("hbm_peak_gib", 0.0))
+                    m_steps.inc(win_steps)
                     last_record = metric_logger.log(step + 1, metrics, extra)
+                    for rec in timeline.drain():
+                        telemetry_jsonl.write(rec)
+                    telemetry_jsonl.write(jsonl_record(telem, step=step + 1))
+                    if is_primary_process():
+                        write_prometheus_file(telem, prom_path)
                 if on_step is not None:
                     on_step(step, metrics)
                 if (
@@ -638,8 +749,19 @@ class Trainer:
         finally:
             # A crash mid-window must still flush the captured trace (and
             # release the process-wide profiler) — the crash run is exactly
-            # when the trace is wanted.
+            # when the trace is wanted. Same for telemetry: the final
+            # snapshot + timeline tail are most valuable on the bad exit.
             profiler.stop()
+            watchdog.stop()
+            try:
+                for rec in timeline.drain():
+                    telemetry_jsonl.write(rec)
+                telemetry_jsonl.write(jsonl_record(telem, step=last_logged))
+                if is_primary_process():
+                    write_prometheus_file(telem, prom_path)
+            except Exception:  # observability must not mask the real error
+                pass
+            telemetry_jsonl.close()
             if hasattr(self.pipeline, "close"):
                 self.pipeline.close()  # stop prefetch worker + in-flight work
             for _sig, _prev in prev_handlers.items():

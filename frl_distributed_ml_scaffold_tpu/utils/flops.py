@@ -99,6 +99,16 @@ def jaxpr_flops(jaxpr) -> int:
     return int(total)
 
 
+def peak_flops_per_chip() -> float:
+    """Per-chip peak FLOP/s for MFU denominators — v5e bf16 (197 TFLOP/s)
+    by default, overridable via ``FRL_PEAK_FLOPS_PER_CHIP`` when the run
+    lands on other silicon. On CPU sim the resulting MFU is a nominal
+    tiny-but-positive placeholder (the serve_bench convention)."""
+    import os
+
+    return float(os.environ.get("FRL_PEAK_FLOPS_PER_CHIP", 197e12))
+
+
 def fn_flops(fn, *example_args) -> int:
     """FLOPs of ``fn(*example_args)`` — traced abstractly, nothing runs."""
     shapes = jax.tree.map(

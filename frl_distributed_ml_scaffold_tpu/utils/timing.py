@@ -93,14 +93,23 @@ class StepTimer:
         return len(self._times)
 
     def summary(self, samples_per_step: int | None = None) -> dict:
-        """Median/p90 step time; samples/sec/chip if batch size given."""
+        """Step-time percentiles (p50/p90/p95/p99) plus mean and
+        samples/sec/chip if batch size given. Granularity follows the
+        feed mode: ``tick()`` every step (benchmark harness) gives true
+        per-step tails; ``tick_window()`` (training loop) records one
+        averaged value per log window, so the tail is across *windows* —
+        a straggler step inside a window is folded into that window's
+        mean and only shows up if it moves the whole window."""
         if not self._times:
             return {"steps_timed": 0}
         arr = np.asarray(self._times)
         out = {
             "steps_timed": int(arr.size),
             "step_time_median_s": float(np.median(arr)),
+            "step_time_p50_s": float(np.median(arr)),
             "step_time_p90_s": float(np.percentile(arr, 90)),
+            "step_time_p95_s": float(np.percentile(arr, 95)),
+            "step_time_p99_s": float(np.percentile(arr, 99)),
             "step_time_mean_s": float(arr.mean()),
             "steps_per_sec": float(1.0 / np.median(arr)),
         }

@@ -545,6 +545,58 @@ def traced_ok(x):
 
 
 @pytest.mark.fast
+@pytest.mark.obs
+def test_hygiene_metrics_in_traced_mutation_gate():
+    """ISSUE 7 mutation gate: a telemetry mutation inside traced code is
+    an ERROR (trace-time freeze or per-step host sync), while the legal
+    look-alikes — jnp's functional ``x.at[i].set(v)`` in traced code and
+    metric writes on the host side of the jitted call — stay clean."""
+    bad = '''
+import jax.numpy as jnp
+
+def traced_decode(x, m_tpot, engine, reg):
+    y = jnp.sum(x)
+    m_tpot.observe(0.001)
+    engine.telemetry.counter("decode_steps_total").inc()
+    reg.gauge("occupancy").set(0.5)
+    return y
+'''
+    findings = [
+        f for f in lint_source(bad, "bad.py") if f.code == "metrics-in-traced"
+    ]
+    # Every metric statement flagged (chained factory+mutator may each
+    # report, so pin the flagged LINES): observe / telemetry chain / set.
+    assert {f.context["line"] for f in findings} == {6, 7, 8}, findings
+    assert all(f.severity == "error" for f in findings)
+    assert {f.context["function"] for f in findings} == {"traced_decode"}
+    calls = {f.context["call"] for f in findings}
+    assert "m_tpot.observe" in calls and "reg.gauge" in calls, calls
+
+    clean = '''
+import jax.numpy as jnp
+import numpy as np
+
+def traced_update(cache, idx, v, done):
+    out = cache.at[idx].set(v)      # functional update, not a gauge
+    done.set()                      # threading.Event.set(): zero args
+    counts, edges = jnp.histogram(out, bins=8)   # array op, not a factory
+    np.histogram(np.ones(4), bins=2)             # ditto at shape time
+    return out * jnp.ones(())
+
+def host_step(engine, fn, x):
+    t0 = perf_counter()
+    y = fn(x)                       # the jitted call
+    engine.m_step.observe(perf_counter() - t0)
+    engine.telemetry.counter("steps_total").inc()
+    return y
+'''
+    assert [
+        f for f in lint_source(clean, "clean.py")
+        if f.code == "metrics-in-traced"
+    ] == []
+
+
+@pytest.mark.fast
 def test_hygiene_repo_traced_modules_are_clean():
     """The repo's own traced modules carry no hygiene errors (warnings
     allowed: shape-time numpy is legal)."""

@@ -252,6 +252,12 @@ def run_arm(model, params, arm: str, args, flops_per_token: int) -> dict:
         bf16_cfg, eng.bucket, kv_dtype_bytes=2
     )
     hbm_budget = int(args.hbm_gb * (1 << 30))
+    # SLO columns from the engine's telemetry histograms (ISSUE 7): the
+    # warm-up pass's observations were dropped by reset_cache, so these
+    # aggregate exactly the measured pass. TTFT is the prefill+graft
+    # latency; TPOT covers the decode steps.
+    ttft_h = eng.telemetry.histogram("serve_ttft_seconds")
+    tpot_h = eng.telemetry.histogram("serve_tpot_seconds")
     lat = np.asarray(
         [dt for c in done for dt in c.token_latencies_s], np.float64
     )
@@ -285,6 +291,10 @@ def run_arm(model, params, arm: str, args, flops_per_token: int) -> dict:
             "tokens_per_sec": round(tok_per_sec, 3),
             "latency_p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 3),
             "latency_p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 3),
+            "ttft_s": round(ttft_h.quantile(0.50), 6),
+            "ttft_p99_s": round(ttft_h.quantile(0.99), 6),
+            "tpot_p50_s": round(tpot_h.quantile(0.50), 6),
+            "tpot_p99_s": round(tpot_h.quantile(0.99), 6),
             "requests": len(work),
             "slots": args.slots,
             "cache_bucket": eng.bucket,

@@ -1,0 +1,177 @@
+#!/usr/bin/env python
+"""Render a run's telemetry JSONL into percentile tables.
+
+Input is the ``telemetry.jsonl`` a Trainer run (or any
+``telemetry.jsonl_record`` producer) writes next to ``metrics.jsonl``:
+``{"event": "telemetry", ...}`` registry snapshots interleaved with
+``{"event": "timeline", ...}`` phase records. The LAST telemetry record
+is cumulative, so the report reads it alone for totals and recomputes
+any quantile straight from the raw log2 bucket counts it carries — no
+re-observation, merge-safe across processes that share the bucket
+ladder.
+
+    python tools/telemetry_report.py <workdir>/<name>/telemetry.jsonl
+    python tools/telemetry_report.py run/telemetry.jsonl --json report.json
+
+The ``--json`` output is the machine-readable form a BENCH_TABLE row's
+evidence can cite (percentiles per histogram, final counters/gauges,
+timeline phase totals).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+#: Percentiles the tables render (quantiles are recomputed from buckets,
+#: so adding one here needs no new data).
+PERCENTILES = (50, 90, 95, 99)
+
+
+def bucket_quantile(buckets: dict[str, int], count: int, q: float) -> float:
+    """Quantile from a snapshot's CUMULATIVE bucket map (the
+    ``telemetry.metrics.Histogram.quantile`` estimator, reconstructed
+    from serialized state): linear interpolation inside the containing
+    bucket, +Inf clamped to the last finite bound."""
+    bounds = sorted(float(k) for k in buckets if k != "+Inf")
+    if count <= 0 or not bounds:
+        return 0.0
+    target = q * count
+    prev_cum = 0
+    for i, b in enumerate(bounds):
+        cum = buckets[_key(buckets, b)]
+        if cum >= target and cum > prev_cum:
+            lo = bounds[i - 1] if i > 0 else 0.0
+            frac = (target - prev_cum) / (cum - prev_cum)
+            return lo + (b - lo) * min(max(frac, 0.0), 1.0)
+        prev_cum = cum
+    return bounds[-1]
+
+
+def _key(buckets: dict[str, int], bound: float) -> str:
+    """Map a parsed float bound back to its serialized dict key."""
+    for k in buckets:
+        if k != "+Inf" and float(k) == bound:
+            return k
+    raise KeyError(bound)
+
+
+def load(path: str) -> dict:
+    """Parse the JSONL; returns {"final": last snapshot metrics,
+    "snapshots": n, "timeline": {name: {count, total_s}}}."""
+    final: dict = {}
+    n_snapshots = 0
+    timeline: dict[str, dict[str, float]] = {}
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if rec.get("event") == "telemetry":
+                final = rec.get("metrics", {})
+                n_snapshots += 1
+            elif rec.get("event") == "timeline":
+                t = timeline.setdefault(
+                    rec.get("name", "?"), {"count": 0, "total_s": 0.0}
+                )
+                t["count"] += 1
+                t["total_s"] += float(rec.get("dur_s", 0.0))
+    if not final:
+        raise ValueError(
+            f"{path}: no telemetry snapshot records "
+            '({"event": "telemetry", ...} lines)'
+        )
+    return {"final": final, "snapshots": n_snapshots, "timeline": timeline}
+
+
+def report(data: dict) -> dict:
+    """The machine-readable report (the ``--json`` payload)."""
+    hists, scalars = [], {}
+    for name, v in sorted(data["final"].items()):
+        if isinstance(v, dict) and v.get("type") == "histogram":
+            count = int(v.get("count", 0))
+            row = {
+                "name": name,
+                "count": count,
+                "sum_s": round(float(v.get("sum", 0.0)), 6),
+                "mean_s": round(float(v.get("sum", 0.0)) / count, 6)
+                if count
+                else 0.0,
+            }
+            for p in PERCENTILES:
+                row[f"p{p}_s"] = round(
+                    bucket_quantile(v.get("buckets", {}), count, p / 100.0), 6
+                )
+            hists.append(row)
+        else:
+            scalars[name] = v
+    return {
+        "snapshots": data["snapshots"],
+        "histograms": hists,
+        "scalars": scalars,
+        "timeline": {
+            name: {"count": int(t["count"]), "total_s": round(t["total_s"], 6)}
+            for name, t in sorted(data["timeline"].items())
+        },
+    }
+
+
+def render(rep: dict, out=sys.stdout) -> None:
+    print(f"telemetry report ({rep['snapshots']} snapshot(s))", file=out)
+    if rep["histograms"]:
+        cols = ["count", "mean_s"] + [f"p{p}_s" for p in PERCENTILES]
+        width = max(len(h["name"]) for h in rep["histograms"])
+        print(
+            f"\n  {'histogram':<{width}s} "
+            + " ".join(f"{c:>12s}" for c in cols),
+            file=out,
+        )
+        for h in rep["histograms"]:
+            print(
+                f"  {h['name']:<{width}s} "
+                + " ".join(
+                    f"{h[c]:12d}" if c == "count" else f"{h[c]:12.6f}"
+                    for c in cols
+                ),
+                file=out,
+            )
+    if rep["scalars"]:
+        print("\n  counters / gauges:", file=out)
+        width = max(len(k) for k in rep["scalars"])
+        for k, v in rep["scalars"].items():
+            print(f"  {k:<{width}s} {v:g}", file=out)
+    if rep["timeline"]:
+        print("\n  timeline phases:", file=out)
+        width = max(len(k) for k in rep["timeline"])
+        for k, t in rep["timeline"].items():
+            print(
+                f"  {k:<{width}s} {t['count']:6d} events "
+                f"{t['total_s']:10.6f} s total",
+                file=out,
+            )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("path", help="telemetry.jsonl to render")
+    ap.add_argument(
+        "--json", dest="json_out", default=None, metavar="PATH",
+        help="also write the machine-readable report ('-' = stdout only)",
+    )
+    args = ap.parse_args(argv)
+    rep = report(load(args.path))
+    if args.json_out == "-":
+        print(json.dumps(rep, indent=1))
+        return 0
+    render(rep)
+    if args.json_out:
+        with open(args.json_out, "w") as fh:
+            json.dump(rep, fh, indent=1)
+        print(f"\nwrote JSON report to {args.json_out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

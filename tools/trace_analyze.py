@@ -221,55 +221,145 @@ def overlap_summary(line, emeta) -> None:
         )
 
 
-def main() -> int:
+def lane_report(events, top_n: int = 20) -> dict:
+    """Machine-readable summary of one XLA Ops lane (the ``--json`` unit).
+
+    ``events``: ``(name, start_ps, end_ps)`` spans — the same pure-data
+    form ``classify_overlap``/``classify_decode`` take, so synthetic
+    spans golden-test the whole structure without an xplane file. The
+    overlap classification here is the artifact PRs diff against each
+    other (tests/golden/trace_analyze_lane.json).
+    """
+    agg: collections.Counter = collections.Counter()
+    n_events: collections.Counter = collections.Counter()
+    for name, a, b in events:
+        agg[name] += b - a
+        n_events[name] += 1
+    n_steps = max(n_events.values(), default=1)
+    overlap = {
+        cls: {k: round(v, 6) for k, v in stats.items()}
+        for cls, stats in classify_overlap(events).items()
+    }
+    has_decode = any(
+        any(k in name for k in DECODE_KERNEL_OPS) for name, _, _ in events
+    )
+    return {
+        "total_ms": round(sum(agg.values()) / 1e9, 6),
+        "n_events": sum(n_events.values()),
+        "top_ops": [
+            {
+                "op": name,
+                "ms_per_step": round(ps / 1e9 / n_steps, 6),
+                "total_ms": round(ps / 1e9, 6),
+                "count": n_events[name],
+            }
+            for name, ps in agg.most_common(top_n)
+        ],
+        "overlap": overlap,
+        "decode": (
+            {k: round(v, 6) for k, v in classify_decode(events).items()}
+            if has_decode
+            else None
+        ),
+    }
+
+
+def analyze(root: str, top_n: int = 20, *, quiet: bool = False) -> dict:
+    """Parse the latest xplane capture under ``root``; print the human
+    tables (unless ``quiet``) and return the ``--json`` report."""
     from tensorflow.tsl.profiler.protobuf import xplane_pb2
 
-    root = sys.argv[1] if len(sys.argv) > 1 else "/tmp/jax_trace"
-    top_n = int(sys.argv[2]) if len(sys.argv) > 2 else 20
     path = find_xplane(root)
     xs = xplane_pb2.XSpace()
     with open(path, "rb") as fh:
         xs.ParseFromString(fh.read())
 
+    report: dict = {"trace": path, "planes": []}
     tpu_planes = [
         p for p in xs.planes if p.name.startswith("/device:TPU")
     ]
     if not tpu_planes:
         # CPU-sim traces carry host thread lines, not per-op device
         # lanes — say so instead of printing nothing.
-        print(
-            f"no /device:TPU plane in {path} (planes: "
-            f"{[p.name for p in xs.planes]}); capture on real TPU for "
-            "the per-op table"
+        report["note"] = (
+            f"no /device:TPU plane (planes: {[p.name for p in xs.planes]}); "
+            "capture on real TPU for the per-op table"
         )
-        return 0
+        if not quiet:
+            print(f"no /device:TPU plane in {path} (planes: "
+                  f"{[p.name for p in xs.planes]}); capture on real TPU for "
+                  "the per-op table")
+        return report
     for plane in tpu_planes:
         emeta = {m.id: m.name for m in plane.event_metadata.values()}
+        plane_rep: dict = {"name": plane.name, "lanes": {}}
         for line in plane.lines:
             if line.name not in ("XLA Ops", "Steps"):
                 continue
-            agg: collections.Counter = collections.Counter()
-            n_events: collections.Counter = collections.Counter()
-            for e in line.events:
-                agg[emeta[e.metadata_id]] += e.duration_ps
-                n_events[emeta[e.metadata_id]] += 1
-            total_ms = sum(agg.values()) / 1e9
-            n_steps = len(line.events) if line.name == "Steps" else max(
-                n_events.values(), default=1
-            )
-            print(f"\n== {plane.name} / {line.name}: {total_ms:.1f} ms total "
-                  f"({len(line.events)} events)")
             if line.name == "Steps":
-                for name, ps in sorted(agg.items()):
-                    print(f"  step {name}: {ps / 1e9:.2f} ms")
+                agg: collections.Counter = collections.Counter()
+                for e in line.events:
+                    agg[emeta[e.metadata_id]] += e.duration_ps
+                total_ms = sum(agg.values()) / 1e9
+                plane_rep["lanes"]["Steps"] = {
+                    "steps": {
+                        name: round(ps / 1e9, 6)
+                        for name, ps in sorted(agg.items())
+                    }
+                }
+                if not quiet:
+                    print(f"\n== {plane.name} / {line.name}: "
+                          f"{total_ms:.1f} ms total "
+                          f"({len(line.events)} events)")
+                    for name, ps in sorted(agg.items()):
+                        print(f"  step {name}: {ps / 1e9:.2f} ms")
                 continue
-            print(f"  {'ms/step':>8s} {'count':>6s}  op")
-            for name, ps in agg.most_common(top_n):
-                print(
-                    f"  {ps / 1e9 / n_steps:8.2f} {n_events[name]:6d}  {name[:120]}"
-                )
-            overlap_summary(line, emeta)
-            decode_summary(line, emeta)
+            # One events materialization + one aggregation per lane:
+            # lane_report owns the Counter walk, the human table reads
+            # its top_ops back out (real traces carry millions of spans).
+            events = [
+                (emeta[e.metadata_id], e.offset_ps,
+                 e.offset_ps + e.duration_ps)
+                for e in line.events
+            ]
+            rep = lane_report(events, top_n)
+            plane_rep["lanes"]["XLA Ops"] = rep
+            if not quiet:
+                print(f"\n== {plane.name} / {line.name}: "
+                      f"{rep['total_ms']:.1f} ms total "
+                      f"({rep['n_events']} events)")
+                print(f"  {'ms/step':>8s} {'count':>6s}  op")
+                for row in rep["top_ops"]:
+                    print(f"  {row['ms_per_step']:8.2f} "
+                          f"{row['count']:6d}  {row['op'][:120]}")
+                overlap_summary(line, emeta)
+                decode_summary(line, emeta)
+        report["planes"].append(plane_rep)
+    return report
+
+
+def main(argv=None) -> int:
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("root", nargs="?", default="/tmp/jax_trace",
+                    help="trace dir (latest *.xplane.pb under it is read)")
+    ap.add_argument("top_n", nargs="?", type=int, default=20)
+    ap.add_argument(
+        "--json", dest="json_out", default=None, metavar="PATH",
+        help="write the machine-readable report here ('-' = stdout, "
+        "suppressing the human tables) — the diffable artifact for "
+        "cross-PR overlap comparisons",
+    )
+    args = ap.parse_args(argv)
+    report = analyze(args.root, args.top_n, quiet=args.json_out == "-")
+    if args.json_out == "-":
+        print(json.dumps(report, indent=1))
+    elif args.json_out:
+        with open(args.json_out, "w") as fh:
+            json.dump(report, fh, indent=1)
+        print(f"wrote JSON report to {args.json_out}")
     return 0
 
 
