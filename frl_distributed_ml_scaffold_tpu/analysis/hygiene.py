@@ -24,13 +24,15 @@ Static Python-source checks for the bug classes that only bite under
   a look — on a tracer it either crashes or silently constant-folds.
 - **metrics-in-traced** (error): a telemetry mutation (``.inc()`` /
   ``.observe()`` / a non-``.at[...]`` ``.set(v)`` / a
-  ``registry.counter|gauge|histogram(...)`` lookup / anything reached
-  through a ``telemetry`` attribute) inside traced code.  The telemetry
-  layer's contract (ISSUE 7, the veScale single-controller argument) is
-  HOST-SIDE ONLY: inside a trace a metric mutation either runs once at
-  trace time and silently freezes, or drags a host sync into every
-  step — both defeat the metric.  ``x.at[idx].set(v)`` is the jnp
-  functional update and stays exempt (the receiver is a subscript).
+  ``registry.counter|gauge|histogram(...)`` lookup / a ``.span(...)``
+  start / anything reached through a ``telemetry``/``tracing``/
+  ``tracer`` attribute chain) inside traced code.  The telemetry
+  layer's contract (ISSUE 7/8, the veScale single-controller argument)
+  is HOST-SIDE ONLY: inside a trace a metric mutation or span
+  start/stop either runs once at trace time and silently freezes, or
+  drags a host clock read + sync into every step — both defeat the
+  signal.  ``x.at[idx].set(v)`` is the jnp functional update and stays
+  exempt (the receiver is a subscript).
 
 "Traced function" is approximated as: a function whose body references
 ``jnp.`` / ``jax.lax`` / ``lax.`` — exactly the modules the repo's traced
@@ -75,6 +77,16 @@ _NP_HOST_SYNC = {"asarray", "array"}
 _METRIC_MUTATORS = {"inc", "observe"}
 _METRIC_FACTORIES = {"counter", "gauge", "histogram"}
 
+# Span/tracing API (telemetry/tracing.py): ``.span(...)`` starts a span;
+# ``begin``/``emit``/``end`` are too generic to flag on their own, so
+# they are caught via the receiver-chain rule instead (any dotted chain
+# through ``telemetry``/``tracing``/``tracer`` — the repo's attribute
+# names for the layer). Same contract as metrics: a span started inside
+# traced code either freezes at trace time or drags a per-step host
+# clock read + sync into the program.
+_SPAN_MUTATORS = {"span"}
+_TELEMETRY_CHAIN_NAMES = {"telemetry", "tracing", "tracer"}
+
 
 # Array/stdlib modules whose methods legitimately collide with metric
 # names (jnp.histogram, np.histogram, jax.numpy.histogram): never metric
@@ -84,9 +96,9 @@ _ARRAY_MODULE_ROOTS = {"jnp", "np", "numpy", "jax", "lax", "scipy"}
 
 
 def _is_metric_call(node: ast.Call, name: str) -> bool:
-    """A telemetry mutation/lookup (see module docstring) — only
-    meaningful inside traced code."""
-    if "telemetry" in name.split("."):
+    """A telemetry mutation/lookup — metric mutators/factories AND span
+    starts (see module docstring) — only meaningful inside traced code."""
+    if _TELEMETRY_CHAIN_NAMES & set(name.split(".")):
         return True
     if not isinstance(node.func, ast.Attribute):
         return False
@@ -97,6 +109,8 @@ def _is_metric_call(node: ast.Call, name: str) -> bool:
     # reg.counter("x").inc() have a Call receiver, where _dotted gives ''.
     attr = node.func.attr
     if attr in _METRIC_MUTATORS or attr in _METRIC_FACTORIES:
+        return True
+    if attr in _SPAN_MUTATORS:
         return True
     if (
         attr == "set"
@@ -216,10 +230,11 @@ def lint_source(
                     Finding(
                         "hygiene", "error", "metrics-in-traced",
                         f"{filename}:{node.lineno} function {fn.name!r} "
-                        f"mutates a telemetry metric ({name or leaf}()) "
-                        "inside traced code — metrics are host-side only "
-                        "(trace-time freeze or a per-step host sync); "
-                        "record around the jitted call instead",
+                        f"mutates a telemetry metric or span "
+                        f"({name or leaf}()) inside traced code — "
+                        "telemetry is host-side only (trace-time freeze "
+                        "or a per-step host sync); record around the "
+                        "jitted call instead",
                         {**where(node), "call": name or leaf,
                          "function": fn.name},
                     )

@@ -382,19 +382,15 @@ def lint_train_step(
     return report
 
 
-def lint_decode_step(
+def build_decode_step_program(
     *, seq_len: int = 96, bucket: int = 16, num_slots: int = 2,
     kv_cache_quant: str = "none",
-) -> Report:
-    """Lint the serving decode path (tiny GPT, bucketed cache): PR 4's
-    no-full-seq_len pin as a materialization-budget finding, plus the
-    engine decode/graft donation audit.
-
-    With ``kv_cache_quant`` set, the program is the QUANTIZED decode step
-    and gains the ISSUE-6 pin: no wide-float intermediate carrying the
-    cache geometry ``(bucket, H, hd)`` — a step that dequantizes the
-    whole cache (instead of per chunk) is an error
-    (``analysis.materialization.wide_intermediates_with_dims``)."""
+):
+    """The tiny-GPT serving decode step as an ABSTRACT program:
+    ``(model, params, cache, tok, jaxpr)``, all shapes eval_shape'd —
+    nothing runs. Shared by ``lint_decode_step`` and the perf ledger
+    (tools/perf_ledger.py), so the linted program and the one the ledger
+    censuses are the same artifact by construction."""
     import jax
     import jax.numpy as jnp
 
@@ -407,13 +403,7 @@ def lint_decode_step(
     )
     from frl_distributed_ml_scaffold_tpu.models.gpt import GPT
     from frl_distributed_ml_scaffold_tpu.precision import get_policy
-    from frl_distributed_ml_scaffold_tpu.serving.engine import ServingEngine
 
-    quant = kv_cache_quant != "none"
-    report = Report(
-        program="serving:decode_step_int8kv" if quant
-        else "serving:decode_step"
-    )
     model = GPT(
         GPTConfig(
             vocab_size=64, num_layers=2, num_heads=2, hidden_dim=32,
@@ -441,6 +431,36 @@ def lint_decode_step(
     jaxpr = jax.make_jaxpr(
         lambda p, c, t: _decode_step(m, p, c, t[:, 0])
     )(params, cache, tok)
+    return model, params, cache, tok, jaxpr
+
+
+def lint_decode_step(
+    *, seq_len: int = 96, bucket: int = 16, num_slots: int = 2,
+    kv_cache_quant: str = "none",
+) -> Report:
+    """Lint the serving decode path (tiny GPT, bucketed cache): PR 4's
+    no-full-seq_len pin as a materialization-budget finding, plus the
+    engine decode/graft donation audit.
+
+    With ``kv_cache_quant`` set, the program is the QUANTIZED decode step
+    and gains the ISSUE-6 pin: no wide-float intermediate carrying the
+    cache geometry ``(bucket, H, hd)`` — a step that dequantizes the
+    whole cache (instead of per chunk) is an error
+    (``analysis.materialization.wide_intermediates_with_dims``)."""
+    import jax
+    import jax.numpy as jnp
+
+    from frl_distributed_ml_scaffold_tpu.serving.engine import ServingEngine
+
+    quant = kv_cache_quant != "none"
+    report = Report(
+        program="serving:decode_step_int8kv" if quant
+        else "serving:decode_step"
+    )
+    model, params, cache, tok, jaxpr = build_decode_step_program(
+        seq_len=seq_len, bucket=bucket, num_slots=num_slots,
+        kv_cache_quant=kv_cache_quant,
+    )
 
     census = collective_census(jaxpr)
     report.meta["collective_census"] = [r.to_dict() for r in census]

@@ -188,9 +188,25 @@ class TrainerConfig:
     # completes dispatch within this many seconds — faulthandler
     # tracebacks + metric snapshot to <run_dir>/stall_dump.txt and a
     # stalls_total counter increment. 0 = off. Size it to several times
-    # the slowest expected step INCLUDING the initial compile (the first
-    # beats land only after dispatch starts flowing).
+    # the slowest expected STEP; the first-beat grace below absorbs the
+    # initial compile.
     stall_timeout_s: float = 0.0
+    # First-beat deadline multiplier: beats only start flowing once
+    # dispatch does, so the initial silence includes XLA compile time —
+    # until the first beat lands the watchdog waits
+    # stall_timeout_s * this. ~5x makes a steady-state-sized deadline
+    # survive the step-0 compile (the false-fire docs/operations.md used
+    # to warn about); 1.0 restores the old strict behavior.
+    stall_timeout_first_beat_scale: float = 5.0
+    # Host-side span tracing (ISSUE 8, telemetry/tracing.py): per-step
+    # spans (step/load_batch/dispatch/checkpoint/eval) recorded around
+    # the jitted calls, teed into telemetry.jsonl as timeline events and
+    # exported as Chrome-trace-event JSON (<run_dir>/trace_events.json —
+    # load in Perfetto next to the device traces profile_steps captures;
+    # the span context managers wrap jax.profiler Trace/StepTrace
+    # annotations so the two align). Ring-bounded host dicts: overhead
+    # is microseconds/step, so it ships on.
+    tracing: bool = True
     # Keep the optimizer state in host memory (``pinned_host``): XLA
     # streams it through HBM around the update. A CAPACITY knob, not a
     # speed knob — it pays PCIe traffic every optimizer step to free

@@ -344,10 +344,22 @@ def supervise(args, cfg: ExperimentConfig) -> int:
     # fleet-level "is this host crash-looping / shrunk" signal.
     from frl_distributed_ml_scaffold_tpu.telemetry import (
         MetricsRegistry,
+        Tracer,
         write_prometheus_file,
     )
 
     telem = MetricsRegistry()
+    # Supervisor tracing (ISSUE 8): one lane per supervision session —
+    # child_run / restart_wait / reform spans, exported as Chrome-trace
+    # JSON next to the .prom sidecar, so an incident (crash → backoff →
+    # shrink → grow-back) reads as ONE trace instead of interleaved log
+    # lines. No profiler annotations: this process owns no devices.
+    tracer = Tracer(enabled=True)
+    sup_trace = tracer.new_trace(f"supervisor {args.process_id or 0}")
+    sup_span = tracer.begin(
+        "supervise", trace=sup_trace, cat="elastic",
+        uid=args.process_id, config=args.config,
+    )
     m_restarts = telem.counter(
         "elastic_restarts_total", help="child restarts under supervision"
     )
@@ -365,6 +377,9 @@ def supervise(args, cfg: ExperimentConfig) -> int:
             os.makedirs(run_dir_t, exist_ok=True)
             write_prometheus_file(
                 telem, os.path.join(run_dir_t, f"supervisor_{uid or 0}.prom")
+            )
+            tracer.write_chrome_trace(
+                os.path.join(run_dir_t, f"supervisor_{uid or 0}_trace.json")
             )
         except OSError as e:  # shared-FS blip: telemetry never kills a run
             logger.warning("elastic: telemetry export failed (%s)", e)
@@ -453,6 +468,13 @@ def supervise(args, cfg: ExperimentConfig) -> int:
             "resharding restore",
             reason, world, new_world, new_rank, new_coord,
         )
+        # Membership change as a span: the committed re-formation moment,
+        # in the same trace as the child runs it separates.
+        tracer.emit(
+            "reform", t0=time.perf_counter(), dur_s=0.0,
+            trace=sup_trace, parent=sup_span, cat="elastic",
+            reason=reason, frm=world, to=new_world, rank=new_rank,
+        )
         world = new_world
         m_reforms.inc()
         (m_grows if reason == "growing" else m_shrinks).inc()
@@ -507,6 +529,7 @@ def supervise(args, cfg: ExperimentConfig) -> int:
         logger.info("elastic: supervising %s", " ".join(cmd))
         while True:
             t0 = time.monotonic()
+            t0_span = time.perf_counter()
             proc = subprocess.Popen(cmd, cwd=_REPO_ROOT, env=env)
             grow_req = threading.Event()
             stop_watch = threading.Event()
@@ -528,6 +551,11 @@ def supervise(args, cfg: ExperimentConfig) -> int:
             if watcher is not None:
                 watcher.join(timeout=5)
             elapsed = time.monotonic() - t0
+            tracer.emit(
+                "child_run", t0=t0_span, dur_s=elapsed,
+                trace=sup_trace, parent=sup_span, cat="elastic",
+                rc=rc, world=world, restarts=restarts,
+            )
 
             if grow_req.is_set():
                 surv = settled_survivors()
@@ -615,12 +643,21 @@ def supervise(args, cfg: ExperimentConfig) -> int:
                 cfg.elastic.max_restarts,
                 delay,
             )
+            t_wait = time.perf_counter()
             time.sleep(delay)
+            tracer.emit(
+                "restart_wait", t0=t_wait,
+                dur_s=time.perf_counter() - t_wait,
+                trace=sup_trace, parent=sup_span, cat="elastic",
+                restart=restarts, rc=rc,
+            )
     finally:
         if held_port is not None:
             held_port.close()
         if membership is not None:
             membership.retire()
+        sup_span.end(world=world)
+        export_telemetry()
 
 
 # --------------------------------------------------------------------------

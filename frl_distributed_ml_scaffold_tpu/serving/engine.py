@@ -57,16 +57,25 @@ from frl_distributed_ml_scaffold_tpu.telemetry import (
     MetricsRegistry,
     StallWatchdog,
     Timeline,
+    Tracer,
 )
 
 
 @dataclasses.dataclass
 class ServeRequest:
-    """One queued generation request (prompt is an unpadded 1-D int array)."""
+    """One queued generation request (prompt is an unpadded 1-D int array).
+
+    ``trace``/``span``/``t_submit`` are the tracing handles (ISSUE 8):
+    every request gets its own trace id at enqueue, and the root
+    ``request`` span stays open from submit to retire so the exported
+    trace reads as one connected tree per request."""
 
     id: int
     prompt: np.ndarray
     max_new_tokens: int
+    trace: int = 0
+    t_submit: float = 0.0
+    span: Any = None
 
 
 @dataclasses.dataclass
@@ -135,8 +144,10 @@ class ServingEngine:
         rng: jax.Array | None = None,
         min_bucket: int = 8,
         telemetry: MetricsRegistry | None = None,
+        tracer: Tracer | None = None,
         stall_timeout_s: float = 0.0,
         stall_dump_path: str | None = None,
+        stall_first_beat_scale: float = 5.0,
     ):
         model, params = _plain_stack(model, params)
         self.model, self.params = model, params
@@ -187,6 +198,24 @@ class ServingEngine:
         # (graft-lint `metrics-in-traced` enforces this).
         self.telemetry = telemetry if telemetry is not None else MetricsRegistry()
         self.timeline = Timeline(enabled=self.telemetry.enabled)
+        # Tracing (ISSUE 8): one span tree per request (trace id assigned
+        # at submit), plus an "engine" lane for the slot-array-scoped
+        # programs (decode steps, bucket grows). Spans tee into the
+        # Timeline, so the existing drain/export path still carries the
+        # phase records, while the tracer ring holds the tree for
+        # export_trace(). Host-side only, same contract as the metrics.
+        self.tracing = (
+            tracer if tracer is not None
+            else Tracer(enabled=self.telemetry.enabled, timeline=self.timeline)
+        )
+        # A caller-supplied tracer (its own timeline, or disabled) breaks
+        # the tee into THIS engine's timeline — _phase() then falls back
+        # to bare timeline events so telemetry.jsonl's phase records and
+        # the watchdog's timeline tail never depend on tracing state.
+        self._phases_via_tee = (
+            self.tracing.enabled and self.tracing.timeline is self.timeline
+        )
+        self._engine_trace = self.tracing.new_trace("engine")
         t = self.telemetry
         self._m_ttft = t.histogram(
             "serve_ttft_seconds", help="time to first token (prefill+graft)"
@@ -229,7 +258,23 @@ class ServingEngine:
             registry=t,
             timeline=self.timeline,
             dump_path=stall_dump_path,
+            first_beat_scale=stall_first_beat_scale,
         )
+
+    def _phase(self, name, *, t0, dur_s, trace=None, parent=None, **attrs):
+        """Span plus guaranteed phase record: the engine-built tracer tees
+        finished spans into ``self.timeline``, which is what keeps
+        ``telemetry.jsonl`` carrying the phase records; with any other
+        tracer the span (if recorded at all) lands elsewhere, so emit a
+        bare timeline event too."""
+        self.tracing.emit(
+            name, t0=t0, dur_s=dur_s, trace=trace, parent=parent,
+            cat="serve", **attrs,
+        )
+        if not self._phases_via_tee:
+            self.timeline.event(
+                name, dur_s=round(max(float(dur_s), 0.0), 9), **attrs
+            )
 
     # ----------------------------------------------------------- frontend
 
@@ -258,7 +303,22 @@ class ServingEngine:
             )
         self._issued_ids.add(rid)
         self._next_id = max(self._next_id, rid) + 1
-        self._queue.append(ServeRequest(rid, prompt, int(max_new_tokens)))
+        req = ServeRequest(rid, prompt, int(max_new_tokens))
+        # Trace-id propagation contract: the id is born HERE, at enqueue,
+        # and every span this request generates (queue_wait, prefill,
+        # graft, decode ticks, retire) carries it — the root "request"
+        # span stays open until retirement so the tree spans
+        # enqueue→retire.
+        req.trace = self.tracing.new_trace(f"request {rid}")
+        req.span = self.tracing.begin(
+            "request", trace=req.trace, cat="serve", request=rid,
+            prompt_len=int(prompt.size),
+        )
+        # One clock read serves both: queue_wait is emitted retroactively
+        # from t_submit, so it must start exactly where the root does or
+        # the tree's containment invariant breaks by a few microseconds.
+        req.t_submit = getattr(req.span, "t0", None) or time.perf_counter()
+        self._queue.append(req)
         return rid
 
     @property
@@ -281,6 +341,7 @@ class ServingEngine:
         # so the measured pass's histograms report serving, not XLA.
         self.telemetry.reset()
         self.timeline.drain()
+        self.tracing.drain()
 
     def bytes_per_slot(self) -> int:
         """Per-slot HBM of the LIVE engine cache at its current bucket —
@@ -296,6 +357,12 @@ class ServingEngine:
     def close(self) -> None:
         """Stop the watchdog thread (daemon — leak-safe either way)."""
         self.watchdog.stop()
+
+    def export_trace(self, path: str) -> None:
+        """Write the span ring as Chrome-trace-event JSON (Perfetto /
+        chrome://tracing). One named lane per request plus the engine
+        lane; non-consuming, so it can be called mid-serve."""
+        self.tracing.write_chrome_trace(path)
 
     def run(self, max_steps: int | None = None) -> list[Completion]:
         """Drain the queue; returns completions in finish order."""
@@ -425,11 +492,17 @@ class ServingEngine:
     def _ensure_bucket(self, needed: int) -> None:
         target = self._bucket_for(needed)
         if target > self.bucket:
+            t0 = time.perf_counter()
             self.cache = self._grow_fn(self.bucket, target)(self.cache)
             self.stats[f"grow_{self.bucket}->{target}"] += 1
             self._m_grows.inc()
-            self.timeline.event(
-                "bucket_grow", frm=self.bucket, to=target
+            # Grows belong to the ENGINE lane, not any one request: the
+            # pad reshapes the shared slot-array cache (the span's tee
+            # keeps the old bucket_grow timeline record alive).
+            self._phase(
+                "bucket_grow", t0=t0, dur_s=time.perf_counter() - t0,
+                trace=self._engine_trace,
+                frm=self.bucket, to=target,
             )
             self.bucket = target
             self._m_bytes_slot.set(self.bytes_per_slot())
@@ -445,6 +518,12 @@ class ServingEngine:
             prompt[0, s_p - l :] = req.prompt  # left-pad, right-aligned
             self._rng, sub = jax.random.split(self._rng)
             t0 = time.perf_counter()
+            # Queue wait is only known now — emit it retrospectively,
+            # spanning submit→admission, as the request tree's first leaf.
+            self._phase(
+                "queue_wait", t0=req.t_submit, dur_s=t0 - req.t_submit,
+                trace=req.trace, parent=req.span, slot=slot,
+            )
             with self._trace_ctx():
                 tok, slot_cache = self._prefill_fn(s_p)(
                     self.params,
@@ -455,9 +534,16 @@ class ServingEngine:
                 if self.cache is None:
                     self.cache = self._empty_cache(slot_cache, s_p)
                     self.bucket = s_p
+                t_graft = time.perf_counter()
                 self._ensure_bucket(max(s_p, l + 1))
                 self.cache = self._graft_fn(s_p, self.bucket)(
                     self.cache, slot_cache, jnp.int32(slot)
+                )
+                self._phase(
+                    "graft", t0=t_graft,
+                    dur_s=time.perf_counter() - t_graft,
+                    trace=req.trace, parent=req.span,
+                    slot=slot, bucket=self.bucket,
                 )
             tok = int(jax.device_get(tok)[0])
             dt = time.perf_counter() - t0
@@ -469,8 +555,10 @@ class ServingEngine:
             self._m_prefills.inc()
             self._m_grafts.inc()
             self._m_bytes_slot.set(self.bytes_per_slot())
-            self.timeline.event(
-                "prefill", dur_s=dt, slot=slot, bucket=s_p, request=req.id
+            self._phase(
+                "prefill", t0=t0, dur_s=dt, trace=req.trace,
+                parent=req.span,
+                slot=slot, bucket=s_p, request=req.id,
             )
             self.watchdog.beat()
 
@@ -519,10 +607,14 @@ class ServingEngine:
         self.stats["completed"] += 1
         self.stats[f"finish_{reason}"] += 1
         self._m_completed.inc()
-        self.timeline.event(
-            "retire", slot=slot, request=req.id, reason=reason,
+        self._phase(
+            "retire", t0=time.perf_counter(), dur_s=0.0,
+            trace=req.trace, parent=req.span,
+            slot=slot, request=req.id, reason=reason,
             n_tokens=len(self._tokens[slot]),
         )
+        # Close the root: the request tree now spans enqueue→retire.
+        req.span.end(finish_reason=reason, n_tokens=len(self._tokens[slot]))
 
     # --------------------------------------------------------------- step
 
@@ -557,9 +649,10 @@ class ServingEngine:
         self.stats[f"decode_{self.bucket}"] += 1
         self.stats["decode_steps"] += 1
         self._m_decodes.inc()
-        self.timeline.event(
-            "decode", dur_s=dt, bucket=self.bucket,
-            active=int(self._active.sum()),
+        # One engine-lane span per slot-array decode program...
+        self._phase(
+            "decode", t0=t0, dur_s=dt, trace=self._engine_trace,
+            bucket=self.bucket, active=int(self._active.sum()),
         )
         self.watchdog.beat()
         if self.telemetry.enabled:
@@ -573,11 +666,20 @@ class ServingEngine:
         for slot in range(self.num_slots):
             if not self._active[slot]:
                 continue
+            req = self._req[slot]
             tok = int(nxt[slot])
             self._tokens[slot].append(tok)
             self._len[slot] += 1
             self._latency[slot].append(dt)
             self._m_tpot.observe(dt)
             self._last_tok[slot] = tok
+            # ...and one request-lane tick per live row, sharing the
+            # program's timing (rows decode together in one program, so
+            # a per-row clock would be fiction).
+            self._phase(
+                "decode_tick", t0=t0, dur_s=dt, trace=req.trace,
+                parent=req.span, slot=slot,
+                token=len(self._tokens[slot]) - 1,
+            )
             self._finishes(slot, tok)
         return self._completed

@@ -14,6 +14,11 @@ from frl_distributed_ml_scaffold_tpu.telemetry.metrics import (
     write_prometheus_file,
 )
 from frl_distributed_ml_scaffold_tpu.telemetry.timeline import Timeline
+from frl_distributed_ml_scaffold_tpu.telemetry.tracing import (
+    Span,
+    Tracer,
+    chrome_trace_events,
+)
 from frl_distributed_ml_scaffold_tpu.telemetry.watchdog import StallWatchdog
 
 __all__ = [
@@ -22,8 +27,11 @@ __all__ = [
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "Span",
     "StallWatchdog",
     "Timeline",
+    "Tracer",
+    "chrome_trace_events",
     "jsonl_record",
     "prometheus_text",
     "write_prometheus_file",

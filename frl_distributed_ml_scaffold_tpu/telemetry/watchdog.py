@@ -34,7 +34,16 @@ from frl_distributed_ml_scaffold_tpu.utils.logging import get_logger
 class StallWatchdog:
     """Fire when no ``beat()`` arrives within ``timeout_s`` (see module
     docstring). ``timeout_s <= 0`` constructs a disabled no-op watchdog
-    (no thread), so callers can wire it unconditionally."""
+    (no thread), so callers can wire it unconditionally.
+
+    ``first_beat_scale`` stretches the deadline until the FIRST beat
+    lands: beats only start flowing once dispatch does, so the initial
+    silence includes XLA compile time — sizing ``timeout_s`` to steady-
+    state steps used to false-fire on step 0 (the compile-time warning
+    docs/operations.md carried). With the default ~5x grace, a deadline
+    sized to the slowest expected *step* tolerates the compile; once any
+    beat arrives the normal deadline applies.
+    """
 
     def __init__(
         self,
@@ -45,8 +54,11 @@ class StallWatchdog:
         timeline: Any | None = None,
         dump_path: str | None = None,
         poll_s: float | None = None,
+        first_beat_scale: float = 5.0,
     ):
         self.timeout_s = float(timeout_s)
+        self.first_beat_scale = max(float(first_beat_scale), 1.0)
+        self._beaten = False  # first beat seen -> normal deadline
         self.name = name
         self._registry = registry
         self._timeline = timeline
@@ -82,6 +94,7 @@ class StallWatchdog:
         from traced code (graft-lint hygiene enforces the same for the
         metric mutations this class makes)."""
         self._last = time.monotonic()
+        self._beaten = True
         self._armed = True
 
     @property
@@ -90,29 +103,34 @@ class StallWatchdog:
 
     def _loop(self, poll: float) -> None:
         while not self._stop.wait(poll):
-            # Read _armed BEFORE _last — the mirror of beat()'s
-            # _last-then-_armed write order. Reading them the other way
-            # around can pair a stale _last with a freshly-set _armed and
-            # fire a spurious "stall" right after progress resumed.
+            # Read _armed BEFORE _beaten BEFORE _last — the mirror of
+            # beat()'s _last-then-_beaten-then-_armed write order.
+            # Reading them the other way around can pair a stale _last
+            # with a freshly-set _armed and fire a spurious "stall" right
+            # after progress resumed. (A stale _beaten=False only widens
+            # the deadline — delays a fire, never invents one.)
             armed = self._armed
+            deadline = self.timeout_s * (
+                1.0 if self._beaten else self.first_beat_scale
+            )
             silent = time.monotonic() - self._last
-            if armed and silent > self.timeout_s:
+            if armed and silent > deadline:
                 self._armed = False  # quiet until the next beat
                 try:
-                    self._fire(silent)
+                    self._fire(silent, deadline)
                 except Exception as e:  # the reporter must never kill a run
                     get_logger().warning(
                         "watchdog[%s]: stall report failed (%s)", self.name, e
                     )
 
-    def _fire(self, silent_s: float) -> None:
+    def _fire(self, silent_s: float, deadline_s: float | None = None) -> None:
         if self._counter is not None:
             self._counter.inc()
         get_logger().error(
             "watchdog[%s]: no progress for %.1fs (deadline %.1fs)%s",
             self.name,
             silent_s,
-            self.timeout_s,
+            deadline_s if deadline_s is not None else self.timeout_s,
             f" — dumping to {self._dump_path}" if self._dump_path else "",
         )
         if self._dump_path is None:

@@ -562,6 +562,7 @@ class Trainer:
             MetricsRegistry,
             StallWatchdog,
             Timeline,
+            Tracer,
             jsonl_record,
             write_prometheus_file,
         )
@@ -572,6 +573,32 @@ class Trainer:
 
         telem = MetricsRegistry()
         timeline = Timeline()
+        # Tracing (ISSUE 8): per-step spans (step → load_batch/dispatch,
+        # plus checkpoint/eval) on one "train" lane. The span context
+        # managers wrap jax.profiler Trace/StepTrace annotations
+        # (annotate=True), so when the profiler window above is armed the
+        # host spans line up with the device trace; the ring additionally
+        # exports Chrome-trace-event JSON (<run_dir>/trace_events.json)
+        # for runs where no window was armed. Spans tee into the Timeline
+        # → telemetry.jsonl, replacing the old bare timeline events.
+        tracer = Tracer(
+            enabled=cfg.trainer.tracing, annotate=True, timeline=timeline
+        )
+        train_trace = tracer.new_trace(cfg.name)
+        if tracer.enabled:
+            def _span_load(step):
+                return tracer.span("load_batch", cat="train", step=step)
+
+            def _span_disp(step):
+                return tracer.span(
+                    "dispatch", cat="train", step=step, step_num=step
+                )
+        else:
+            # tracing=false must not strip the profiler annotations the
+            # profile_steps window relies on — the two knobs are
+            # independent (a disabled tracer's spans carry no annotation).
+            _span_load = lambda step: annotate("load_batch")  # noqa: E731
+            _span_disp = annotate_step
         telemetry_jsonl = JsonlWriter(os.path.join(run_dir, "telemetry.jsonl"))
         prom_path = os.path.join(run_dir, "metrics.prom")
         m_step = telem.histogram(
@@ -601,6 +628,9 @@ class Trainer:
             registry=telem,
             timeline=timeline,
             dump_path=os.path.join(run_dir, "stall_dump.txt"),
+            # Beats only flow once dispatch does: the first deadline must
+            # absorb the initial XLA compile, not false-fire on it.
+            first_beat_scale=cfg.trainer.stall_timeout_first_beat_scale,
         )
         flops_per_step: float | None = None  # lazy; False once probing failed
         window_wait = 0.0
@@ -634,19 +664,29 @@ class Trainer:
 
             for step in range(start_step, total):
                 profiler.step_start(step)
-                t_load = _time.perf_counter()
-                with annotate("load_batch"):
-                    batch = self.pipeline.global_batch(step)
-                data_wait = _time.perf_counter() - t_load
-                window_wait += data_wait
-                m_wait.observe(data_wait)
-                timeline.event("load_batch", dur_s=data_wait, step=step)
-                t_disp = _time.perf_counter()
-                with annotate_step(step):
-                    state, metrics = self.train_step(state, batch)
-                timeline.event(
-                    "dispatch", dur_s=_time.perf_counter() - t_disp, step=step
-                )
+                with tracer.span(
+                    "step", trace=train_trace, cat="train", step=step
+                ):
+                    t_load = _time.perf_counter()
+                    with _span_load(step):
+                        batch = self.pipeline.global_batch(step)
+                    data_wait = _time.perf_counter() - t_load
+                    window_wait += data_wait
+                    m_wait.observe(data_wait)
+                    # H2D + enqueue of the async device step: the
+                    # StepTraceAnnotation (step_num) groups it with the
+                    # device timeline in the profiler trace.
+                    t_disp = _time.perf_counter()
+                    with _span_disp(step):
+                        state, metrics = self.train_step(state, batch)
+                if not tracer.enabled:
+                    # tracing=false must not silence telemetry.jsonl's
+                    # phase records — fall back to bare timeline events.
+                    timeline.event("load_batch", dur_s=data_wait, step=step)
+                    timeline.event(
+                        "dispatch",
+                        dur_s=_time.perf_counter() - t_disp, step=step,
+                    )
                 watchdog.beat()
                 if (step + 1) % cfg.trainer.log_every == 0 or step + 1 == total:
                     win_steps = step + 1 - last_logged
@@ -714,9 +754,16 @@ class Trainer:
                     self.checkpointer is not None
                     and (step + 1) % cfg.checkpoint.save_every == 0
                 ):
-                    self.checkpointer.save(step + 1, state)
+                    with tracer.span(
+                        "checkpoint", trace=train_trace, cat="train",
+                        step=step + 1,
+                    ):
+                        self.checkpointer.save(step + 1, state)
                 if cfg.trainer.eval_every and (step + 1) % cfg.trainer.eval_every == 0:
-                    eval_metrics = self.evaluate(state)
+                    with tracer.span(
+                        "eval", trace=train_trace, cat="train", step=step + 1
+                    ):
+                        eval_metrics = self.evaluate(state)
                     metric_logger.log(step + 1, eval_metrics, {"split": "eval"})
                 if preempt["signum"] is not None:
                     self.logger.warning(
@@ -744,7 +791,11 @@ class Trainer:
             if not preempt.get("exited_early") and self.checkpointer is not None:
                 if total % cfg.checkpoint.save_every != 0:
                     # Final state not yet covered by the periodic save above.
-                    self.checkpointer.save(total, state, force=True)
+                    with tracer.span(
+                        "checkpoint", trace=train_trace, cat="train",
+                        step=total, final=True,
+                    ):
+                        self.checkpointer.save(total, state, force=True)
                 self.checkpointer.wait()
         finally:
             # A crash mid-window must still flush the captured trace (and
@@ -759,6 +810,14 @@ class Trainer:
                 telemetry_jsonl.write(jsonl_record(telem, step=last_logged))
                 if is_primary_process():
                     write_prometheus_file(telem, prom_path)
+                    if tracer.enabled:
+                        # The span tree (ring tail on long runs) as
+                        # Chrome-trace-event JSON — the Perfetto view of
+                        # what the host loop was doing, crash runs
+                        # included.
+                        tracer.write_chrome_trace(
+                            os.path.join(run_dir, "trace_events.json")
+                        )
             except Exception:  # observability must not mask the real error
                 pass
             telemetry_jsonl.close()

@@ -78,13 +78,45 @@ def get_logger(name: str = "frl_tpu") -> logging.Logger:
     return logger
 
 
+def _truncate_partial_line(path: str) -> None:
+    """Crash-safety on reopen: a process killed mid-``write`` (OOM,
+    SIGKILL, preemption without grace) leaves a torn final line, which
+    poisons every later line-by-line reader of the file. Drop everything
+    after the last newline BEFORE appending resumes — the torn record is
+    unrecoverable either way; the file staying parseable is what
+    matters."""
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return  # no file yet: nothing to repair
+    if size == 0:
+        return
+    with open(path, "rb+") as fh:
+        fh.seek(size - 1)
+        if fh.read(1) == b"\n":
+            return  # clean shutdown last time
+        pos = size
+        while pos > 0:
+            step = min(65536, pos)
+            fh.seek(pos - step)
+            idx = fh.read(step).rfind(b"\n")
+            if idx >= 0:
+                fh.truncate(pos - step + idx + 1)
+                return
+            pos -= step
+        fh.truncate(0)  # single torn line: the whole file is the tear
+
+
 class JsonlWriter:
-    """Append-only JSONL metric sink, primary-process only."""
+    """Append-only JSONL metric sink, primary-process only. Reopening an
+    existing file first truncates any torn final line (crash-safety —
+    see ``_truncate_partial_line``)."""
 
     def __init__(self, path: str | None):
         self._fh: IO[str] | None = None
         if path and is_primary_process():
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            _truncate_partial_line(path)
             self._fh = open(path, "a", buffering=1)
 
     def write(self, record: Mapping[str, Any]) -> None:

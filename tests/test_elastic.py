@@ -214,6 +214,22 @@ def test_supervisor_kill_and_resume(tmp_path):
         int(d) for d in os.listdir(os.path.join(run_dir, "ckpt")) if d.isdigit()
     )
     assert 12 in ckpt_steps
+    # Supervisor tracing (ISSUE 8): the incident reads as one trace —
+    # a crashed child_run (the fault's rc), a restart_wait, and the
+    # clean child_run, all on the supervisor lane.
+    trace = json.load(
+        open(os.path.join(run_dir, "supervisor_0_trace.json"))
+    )
+    spans = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    child_rcs = [e["args"]["rc"] for e in spans if e["name"] == "child_run"]
+    assert child_rcs == [43, 0], child_rcs  # FAULT_EXIT_CODE then clean
+    assert any(e["name"] == "restart_wait" for e in spans)
+    roots = [e for e in spans if e["name"] == "supervise"]
+    assert len(roots) == 1
+    root_id = roots[0]["args"]["span"]
+    assert all(
+        e["args"]["parent"] == root_id for e in spans if e is not roots[0]
+    )
 
 
 def test_sigterm_preempts_checkpoint_and_resume(tmp_path):

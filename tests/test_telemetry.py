@@ -176,6 +176,58 @@ def test_timeline_ring_buffer_and_drain():
     assert len(off) == 0
 
 
+@pytest.mark.fast
+def test_timeline_double_drain_and_post_wraparound_refill():
+    """Satellite: drain() is idempotent on empty (the crash-path finally
+    block re-drains after the log-boundary drain — must yield [] not
+    duplicates), and the ring keeps accepting/counting after wrapping."""
+    tl = Timeline(capacity=3)
+    for i in range(5):
+        tl.event("p", step=i)
+    first = tl.drain()
+    assert [r["step"] for r in first] == [2, 3, 4]
+    assert tl.drain() == [] and tl.drain() == []  # double (and triple)
+    assert tl.dropped == 2  # dropped survives drains: it is a counter
+    # Refill after wraparound+drain behaves like a fresh ring.
+    for i in range(4):
+        tl.event("q", step=10 + i)
+    assert tl.dropped == 3
+    assert [r["step"] for r in tl.drain()] == [11, 12, 13]
+    assert tl.tail() == []
+
+
+@pytest.mark.fast
+def test_jsonl_writer_truncates_partial_line_on_reopen(tmp_path):
+    """Satellite: a run killed mid-write leaves a torn final line; the
+    next JsonlWriter open repairs the file (truncate to the last
+    newline) so every line stays parseable across the crash."""
+    from frl_distributed_ml_scaffold_tpu.utils.logging import JsonlWriter
+
+    path = tmp_path / "t.jsonl"
+    w = JsonlWriter(str(path))
+    w.write({"step": 1})
+    w.write({"step": 2})
+    w.close()
+    with open(path, "a") as fh:  # the torn write (no trailing newline)
+        fh.write('{"step": 3, "partial')
+    w2 = JsonlWriter(str(path))
+    w2.write({"step": 4})
+    w2.close()
+    recs = [json.loads(l) for l in open(path)]  # every line parses
+    assert [r["step"] for r in recs] == [1, 2, 4]
+    # A torn FIRST line (no complete record at all) truncates to empty.
+    p2 = tmp_path / "torn.jsonl"
+    p2.write_text('{"never finished')
+    w3 = JsonlWriter(str(p2))
+    w3.write({"ok": 1})
+    w3.close()
+    assert [json.loads(l)["ok"] for l in open(p2)] == [1]
+    # A cleanly-closed file reopens untouched.
+    w4 = JsonlWriter(str(path))
+    w4.close()
+    assert [r["step"] for r in (json.loads(l) for l in open(path))] == [1, 2, 4]
+
+
 # ---------------------------------------------------------------- watchdog
 
 
@@ -230,6 +282,44 @@ def test_watchdog_disabled_spawns_no_thread():
     assert not wd.enabled
     wd.beat()
     wd.stop()  # no-op, no thread to join
+
+
+@pytest.mark.fast
+def test_watchdog_first_beat_grace_absorbs_compile():
+    """Satellite: before the FIRST beat the deadline is scaled by
+    first_beat_scale (the step-0 compile window) — a slow first beat
+    does not fire; a LATER silence of the same length does."""
+    reg = MetricsRegistry()
+    wd = StallWatchdog(
+        0.2, registry=reg, poll_s=0.02, first_beat_scale=10.0
+    )
+    try:
+        # 3x the deadline, but 1.4 s under the 10x first-beat grace —
+        # wide enough that a loaded CI host cannot false-fire it.
+        time.sleep(0.6)
+        assert wd.fired == 0
+        wd.beat()  # "compile finished, step 0 dispatched"
+        time.sleep(0.6)  # the SAME silence after a beat: normal deadline
+        assert wd.fired == 1
+    finally:
+        wd.stop()
+
+
+@pytest.mark.fast
+def test_watchdog_unbeaten_still_fires_at_scaled_deadline():
+    """The grace is a multiplier, not a disable: a child that never
+    beats at all (hung before step 0) fires once the scaled deadline
+    passes."""
+    reg = MetricsRegistry()
+    wd = StallWatchdog(
+        0.1, registry=reg, poll_s=0.02, first_beat_scale=3.0
+    )
+    try:
+        time.sleep(0.6)  # past 3 * 0.1
+        assert wd.fired == 1
+        assert reg.counter("stalls_total").value == 1
+    finally:
+        wd.stop()
 
 
 # ----------------------------------------------------------------- serving

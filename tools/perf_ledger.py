@@ -1,0 +1,496 @@
+#!/usr/bin/env python
+"""Perf-attribution ledger: census-vs-measured roofline table + gate.
+
+Joins graft-lint's ANALYTIC side (collective census bytes + jaxpr-counted
+FLOPs per recipe — deterministic, trace-only, the SimpleFSDP
+compile-artifact-accounting shape, arXiv 2411.00284) with the telemetry
+layer's MEASURED side (step-time histograms from a tiny CPU-sim fit;
+TTFT/TPOT from a tiny serve run) into one per-recipe attribution row:
+
+- ``flops_per_step`` / ``collective_bytes_per_step`` / arithmetic
+  intensity, and the roofline verdict (compute- vs comm-bound at the
+  configured peaks);
+- measured ``step_time_p50_s``, achieved FLOP/s, and MFU — so "where did
+  the time go" has an analytic denominator next to every measured number.
+
+With the on-chip bench relay down (BACKLOG R6-1/R7-1/R8-1), this is the
+repo's regression gate: the analytic side is bit-deterministic on the
+CPU sim, so ``--check`` against the committed baseline
+(``PERF_LEDGER.json``) catches any change to a step's communication or
+compute census — the promoted, blocking form of graft-lint's advisory
+census diff. Measured columns are provenance (stamped when the baseline
+was built) and are only re-compared under ``--measure-steps``, with a
+wide tolerance, because CPU-sim wall time is load-dependent.
+
+    python tools/perf_ledger.py --write PERF_LEDGER.json --measure-steps 6
+    python tools/perf_ledger.py --check                  # the CI gate
+    python tools/perf_ledger.py --check --measure-steps 6 --tol 3.0
+
+Exit is nonzero when any baseline row's analytic fields drift, a
+baseline recipe disappears, or (under ``--measure-steps``) a measured
+step time leaves its tolerance band.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# Platform pins BEFORE jax imports (the graft_lint.py / conftest.py
+# discipline): the environment may pin JAX_PLATFORMS to a real TPU plugin.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+#: Default baseline location (committed at the repo root, next to
+#: BASELINE.json / BENCH_TABLE.jsonl).
+DEFAULT_BASELINE = os.path.join(_REPO, "PERF_LEDGER.json")
+
+#: The committed tiny-recipe set: one replicated-DDP recipe (census is
+#: empty at the jaxpr level — GSPMD owns its collectives) and one
+#: explicit-schedule recipe (the ppermute rings ARE the census). Small
+#: enough that --check stays inside the lint tier's budget.
+DEFAULT_RECIPES = ("mnist_mlp", "gpt2_medium_tp_overlap")
+
+SERVING_PROGRAM = "serving:decode_step"
+
+#: Analytic row fields --check compares EXACTLY. Everything else in a row
+#: (intensity, roofline, measured) is either derived from these or
+#: measured wall time.
+ANALYTIC_KEYS = (
+    "flops_per_step",
+    "collective_bytes_per_step",
+    "collectives",
+    "params_bytes",
+    "chips",
+)
+
+
+def peak_ici_bytes_per_chip_s() -> float:
+    """Per-chip interconnect bandwidth for the roofline's comm leg —
+    v5e ICI (~4.5e10 B/s per link direction x 2 links, a deliberately
+    round planning number, not a datasheet quote), overridable via
+    ``FRL_PEAK_ICI_BYTES_PER_CHIP`` when the mesh lands elsewhere."""
+    return float(os.environ.get("FRL_PEAK_ICI_BYTES_PER_CHIP", 9e10))
+
+
+def _tree_bytes(tree) -> int:
+    import jax
+    import numpy as np
+
+    return int(
+        sum(
+            int(np.prod(l.shape)) * np.dtype(l.dtype).itemsize
+            for l in jax.tree.leaves(tree)
+        )
+    )
+
+
+def _roofline(flops: int, comm_bytes: int, chips: int) -> dict:
+    """Lower-bound times at the configured peaks and the resulting bound
+    verdict. NOT compared by --check (env overrides move the peaks);
+    recomputed at read time for the table."""
+    from frl_distributed_ml_scaffold_tpu.utils.flops import (
+        peak_flops_per_chip,
+    )
+
+    peak_f = peak_flops_per_chip()
+    peak_b = peak_ici_bytes_per_chip_s()
+    compute_s = flops / (chips * peak_f) if flops else 0.0
+    comm_s = comm_bytes / (chips * peak_b) if comm_bytes else 0.0
+    return {
+        "compute_s_lower_bound": compute_s,
+        "comm_s_lower_bound": comm_s,
+        "bound": "compute" if compute_s >= comm_s else "comm",
+    }
+
+
+def analytic_recipe_row(name: str, workdir: str) -> dict:
+    """The deterministic half of a recipe's row: jaxpr FLOPs + collective
+    census of the (tiny-twin) train step, shapes via analysis.runner."""
+    import jax
+
+    from frl_distributed_ml_scaffold_tpu.analysis.collectives import (
+        census_summary,
+        collective_census,
+    )
+    from frl_distributed_ml_scaffold_tpu.analysis.runner import (
+        _abstract_batch,
+        _build_trainer,
+    )
+    from frl_distributed_ml_scaffold_tpu.utils.flops import jaxpr_flops
+
+    trainer = _build_trainer(name, workdir)
+    batch = _abstract_batch(trainer)
+    jaxpr = trainer._mesh_scoped(jax.make_jaxpr(trainer._train_step_fn))(
+        trainer.state_shapes, batch
+    )
+    census = collective_census(jaxpr)
+    flops = jaxpr_flops(jaxpr)
+    comm = sum(r.total_bytes for r in census)
+    chips = jax.device_count()
+    return {
+        "flops_per_step": flops,
+        "collective_bytes_per_step": comm,
+        "collectives": {
+            prim: agg for prim, agg in sorted(census_summary(census).items())
+        },
+        "params_bytes": _tree_bytes(trainer.state_shapes.params),
+        "chips": chips,
+        "intensity_flops_per_byte": round(flops / max(comm, 1), 3),
+        "roofline": _roofline(flops, comm, chips),
+    }
+
+
+def analytic_serving_row() -> dict:
+    """Same, for the serving decode step (the graft-lint program, shared
+    via analysis.runner.build_decode_step_program)."""
+    import jax
+
+    from frl_distributed_ml_scaffold_tpu.analysis.collectives import (
+        census_summary,
+        collective_census,
+    )
+    from frl_distributed_ml_scaffold_tpu.analysis.runner import (
+        build_decode_step_program,
+    )
+    from frl_distributed_ml_scaffold_tpu.utils.flops import jaxpr_flops
+
+    _, params, cache, _, jaxpr = build_decode_step_program()
+    census = collective_census(jaxpr)
+    flops = jaxpr_flops(jaxpr)
+    comm = sum(r.total_bytes for r in census)
+    chips = jax.device_count()
+    return {
+        "flops_per_step": flops,
+        "collective_bytes_per_step": comm,
+        "collectives": {
+            prim: agg for prim, agg in sorted(census_summary(census).items())
+        },
+        "params_bytes": _tree_bytes(params),
+        "chips": chips,
+        "cache_bytes": _tree_bytes(cache),
+        "intensity_flops_per_byte": round(flops / max(comm, 1), 3),
+        "roofline": _roofline(flops, comm, chips),
+    }
+
+
+def measure_recipe(name: str, steps: int, workdir: str) -> dict:
+    """The measured half: a tiny real fit on the CPU sim, reading the
+    step-time percentiles the telemetry layer already computes. Wall
+    time, not a pin — compared only under --measure-steps, with --tol."""
+    from frl_distributed_ml_scaffold_tpu.analysis.runner import (
+        RECIPE_OVERRIDES,
+        _COMMON,
+    )
+    from frl_distributed_ml_scaffold_tpu.config import (
+        apply_overrides,
+        get_config,
+    )
+    from frl_distributed_ml_scaffold_tpu.dist.mesh import build_mesh
+    from frl_distributed_ml_scaffold_tpu.trainer.loop import Trainer
+
+    cfg = apply_overrides(
+        get_config(name),
+        _COMMON + RECIPE_OVERRIDES[name] + [
+            f"workdir={workdir}",
+            f"trainer.total_steps={steps}",
+            "trainer.log_every=2",
+        ],
+    )
+    trainer = Trainer(cfg, mesh_env=build_mesh(cfg.mesh))
+    _, last = trainer.fit()
+    return {
+        "steps": steps,
+        "step_time_p50_s": float(last.get("step_time_p50_s", 0.0)),
+        "step_time_p99_s": float(last.get("step_time_p99_s", 0.0)),
+        "samples_per_sec_per_chip": float(
+            last.get("samples_per_sec_per_chip", 0.0)
+        ),
+    }
+
+
+def measure_serving(n_requests: int = 4) -> dict:
+    """TTFT/TPOT percentiles from a tiny warm serve run (the serve_bench
+    warm-up discipline: compile-polluted pass dropped via reset_cache)."""
+    import jax
+    import numpy as np
+
+    from frl_distributed_ml_scaffold_tpu.analysis.runner import (
+        build_decode_step_program,
+    )
+    from frl_distributed_ml_scaffold_tpu.serving.engine import ServingEngine
+
+    model, _, _, _, _ = build_decode_step_program()
+    tokens = jax.random.randint(jax.random.key(0), (2, 8), 0, 64)
+    params = jax.jit(
+        lambda: model.init(
+            {"params": jax.random.key(0)}, tokens, train=False
+        )["params"]
+    )()
+    rng = np.random.default_rng(0)
+    work = [
+        (rng.integers(0, 64, size=int(rng.integers(2, 10))).astype(np.int32),
+         int(rng.integers(2, 8)))
+        for _ in range(n_requests)
+    ]
+    eng = ServingEngine(model, params, num_slots=2, temperature=0.0)
+    try:
+        for prompt, n_new in work:  # warm pass: compiles
+            eng.submit(prompt, n_new)
+        eng.run()
+        eng.reset_cache()
+        for prompt, n_new in work:  # measured pass
+            eng.submit(prompt, n_new)
+        eng.run()
+        snap = eng.telemetry.snapshot()
+        return {
+            "requests": n_requests,
+            "ttft_p50_s": snap["serve_ttft_seconds"]["p50"],
+            "ttft_p99_s": snap["serve_ttft_seconds"]["p99"],
+            "tpot_p50_s": snap["serve_tpot_seconds"]["p50"],
+            "tpot_p99_s": snap["serve_tpot_seconds"]["p99"],
+        }
+    finally:
+        eng.close()
+
+
+def _attribution(row: dict) -> dict:
+    """Measured-vs-analytic join: achieved FLOP/s, MFU, and the headroom
+    multiple over the roofline lower bound."""
+    from frl_distributed_ml_scaffold_tpu.utils.flops import (
+        peak_flops_per_chip,
+    )
+
+    measured = row.get("measured") or {}
+    t = measured.get("step_time_p50_s", 0.0)
+    if not t:
+        return {}
+    flops = row["flops_per_step"]
+    chips = row["chips"]
+    achieved = flops / t
+    lb = max(
+        row["roofline"]["compute_s_lower_bound"],
+        row["roofline"]["comm_s_lower_bound"],
+        1e-12,
+    )
+    return {
+        "achieved_flops_per_s": achieved,
+        "mfu": achieved / (chips * peak_flops_per_chip()),
+        "headroom_vs_roofline": round(t / lb, 3),
+    }
+
+
+def build_ledger(
+    recipes,
+    *,
+    serving: bool = True,
+    measure_steps: int = 0,
+    workdir: str = "/tmp/perf_ledger",
+) -> dict:
+    rows: dict[str, dict] = {}
+    for name in recipes:
+        print(f"perf_ledger: tracing recipe:{name}", flush=True)
+        row = analytic_recipe_row(name, workdir)
+        if measure_steps > 0:
+            print(f"perf_ledger: measuring recipe:{name} "
+                  f"({measure_steps} steps)", flush=True)
+            row["measured"] = measure_recipe(name, measure_steps, workdir)
+            row["attribution"] = _attribution(row)
+        rows[f"recipe:{name}"] = row
+    if serving:
+        print(f"perf_ledger: tracing {SERVING_PROGRAM}", flush=True)
+        row = analytic_serving_row()
+        if measure_steps > 0:
+            print(f"perf_ledger: measuring {SERVING_PROGRAM}", flush=True)
+            row["measured"] = measure_serving()
+        rows[SERVING_PROGRAM] = row
+    from frl_distributed_ml_scaffold_tpu.utils.flops import (
+        peak_flops_per_chip,
+    )
+
+    return {
+        "version": 1,
+        "generated_by": "tools/perf_ledger.py",
+        "peak_flops_per_chip": peak_flops_per_chip(),
+        "peak_ici_bytes_per_chip_s": peak_ici_bytes_per_chip_s(),
+        "rows": rows,
+    }
+
+
+def check_ledger(
+    baseline: dict,
+    *,
+    measure_steps: int = 0,
+    tol: float = 3.0,
+    workdir: str = "/tmp/perf_ledger",
+) -> list[str]:
+    """Drift findings (empty = green). Analytic fields compare exactly;
+    measured step time within a factor of ``tol`` when re-measured."""
+    problems: list[str] = []
+    for program, base in sorted(baseline.get("rows", {}).items()):
+        if program == SERVING_PROGRAM:
+            try:
+                cur = analytic_serving_row()
+            except Exception as e:
+                problems.append(
+                    f"{program}: baseline program no longer traces "
+                    f"({type(e).__name__}: {e})"
+                )
+                continue
+        elif program.startswith("recipe:"):
+            name = program.split(":", 1)[1]
+            try:
+                cur = analytic_recipe_row(name, workdir)
+            except Exception as e:
+                problems.append(
+                    f"{program}: baseline recipe no longer traces "
+                    f"({type(e).__name__}: {e})"
+                )
+                continue
+        else:
+            problems.append(f"{program}: unknown program class in baseline")
+            continue
+        for key in ANALYTIC_KEYS:
+            if base.get(key) != cur.get(key):
+                problems.append(
+                    f"{program}: {key} drifted — baseline "
+                    f"{json.dumps(base.get(key))} vs current "
+                    f"{json.dumps(cur.get(key))}"
+                )
+        if "cache_bytes" in base and base["cache_bytes"] != cur.get(
+            "cache_bytes"
+        ):
+            problems.append(
+                f"{program}: cache_bytes drifted — baseline "
+                f"{base['cache_bytes']} vs current {cur.get('cache_bytes')}"
+            )
+        if measure_steps > 0 and program.startswith("recipe:"):
+            base_t = (base.get("measured") or {}).get("step_time_p50_s", 0.0)
+            if base_t > 0:
+                name = program.split(":", 1)[1]
+                now_t = measure_recipe(name, measure_steps, workdir)[
+                    "step_time_p50_s"
+                ]
+                if now_t > base_t * tol or now_t < base_t / tol:
+                    problems.append(
+                        f"{program}: measured step_time_p50_s {now_t:.6f}s "
+                        f"outside [{base_t / tol:.6f}, {base_t * tol:.6f}] "
+                        f"({tol}x band around baseline {base_t:.6f}s)"
+                    )
+    return problems
+
+
+def render(ledger: dict, out=sys.stdout) -> None:
+    rows = ledger.get("rows", {})
+    if not rows:
+        return
+    width = max(len(p) for p in rows)
+    print(
+        f"  {'program':<{width}s} {'flops/step':>12s} {'comm B/step':>12s} "
+        f"{'F/B':>10s} {'bound':>8s} {'p50 step s':>11s} {'mfu':>9s}",
+        file=out,
+    )
+    for program, r in sorted(rows.items()):
+        measured = r.get("measured") or {}
+        t = measured.get("step_time_p50_s", measured.get("tpot_p50_s", 0.0))
+        mfu = (r.get("attribution") or {}).get("mfu", 0.0)
+        print(
+            f"  {program:<{width}s} {r['flops_per_step']:>12.3e} "
+            f"{r['collective_bytes_per_step']:>12d} "
+            f"{r['intensity_flops_per_byte']:>10.1f} "
+            f"{r['roofline']['bound']:>8s} "
+            f"{t:>11.6f} {mfu:>9.2e}",
+            file=out,
+        )
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument(
+        "--write", metavar="PATH", default=None,
+        help="build the ledger and write it here (the baseline refresh)",
+    )
+    ap.add_argument(
+        "--check", action="store_true",
+        help="recompute the analytic side and gate against --baseline",
+    )
+    ap.add_argument(
+        "--baseline", default=DEFAULT_BASELINE,
+        help=f"baseline path (default: {DEFAULT_BASELINE})",
+    )
+    ap.add_argument(
+        "--recipes", default=",".join(DEFAULT_RECIPES),
+        help="comma-separated recipe names for --write",
+    )
+    ap.add_argument(
+        "--no-serving", action="store_true",
+        help="skip the serving decode-step row",
+    )
+    ap.add_argument(
+        "--measure-steps", type=int, default=0, metavar="N",
+        help="also run N-step CPU-sim fits for the measured columns "
+        "(and, under --check, re-compare step time within --tol)",
+    )
+    ap.add_argument(
+        "--tol", type=float, default=3.0,
+        help="relative band for re-measured step time under --check "
+        "(default 3.0 = within 3x either way)",
+    )
+    ap.add_argument(
+        "--workdir", default="/tmp/perf_ledger",
+        help="scratch workdir for recipe construction",
+    )
+    args = ap.parse_args(argv)
+    if not args.write and not args.check:
+        ap.error("pass --write PATH or --check")
+
+    if args.write:
+        ledger = build_ledger(
+            [r for r in args.recipes.split(",") if r],
+            serving=not args.no_serving,
+            measure_steps=args.measure_steps,
+            workdir=args.workdir,
+        )
+        with open(args.write, "w") as fh:
+            json.dump(ledger, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        render(ledger)
+        print(f"wrote {len(ledger['rows'])} rows to {args.write}")
+        return 0
+
+    with open(args.baseline) as fh:
+        baseline = json.load(fh)
+    problems = check_ledger(
+        baseline,
+        measure_steps=args.measure_steps,
+        tol=args.tol,
+        workdir=args.workdir,
+    )
+    render(baseline)
+    if problems:
+        for p in problems:
+            print(f"DRIFT: {p}")
+        print(
+            f"perf_ledger: {len(problems)} drift finding(s) vs "
+            f"{args.baseline} — if the change is intended, refresh the "
+            "baseline in the same commit (--write)"
+        )
+        return 1
+    print(
+        f"perf_ledger: {len(baseline.get('rows', {}))} rows match "
+        f"{args.baseline}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

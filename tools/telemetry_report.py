@@ -12,10 +12,18 @@ ladder.
 
     python tools/telemetry_report.py <workdir>/<name>/telemetry.jsonl
     python tools/telemetry_report.py run/telemetry.jsonl --json report.json
+    python tools/telemetry_report.py --diff run_a/telemetry.jsonl \
+                                            run_b/telemetry.jsonl
 
 The ``--json`` output is the machine-readable form a BENCH_TABLE row's
 evidence can cite (percentiles per histogram, final counters/gauges,
 timeline phase totals).
+
+``--diff`` renders the A→B percentile-delta table over two runs' JSONLs:
+every quantile is recomputed from each side's serialized bucket counts
+(merge-safe, no re-observation — the shared log2 ladder is what makes
+the subtraction meaningful), so "did this PR move TTFT p99" is one
+command over two run dirs.
 """
 
 from __future__ import annotations
@@ -118,7 +126,8 @@ def report(data: dict) -> dict:
     }
 
 
-def render(rep: dict, out=sys.stdout) -> None:
+def render(rep: dict, out=None) -> None:
+    out = out if out is not None else sys.stdout
     print(f"telemetry report ({rep['snapshots']} snapshot(s))", file=out)
     if rep["histograms"]:
         cols = ["count", "mean_s"] + [f"p{p}_s" for p in PERCENTILES]
@@ -153,19 +162,114 @@ def render(rep: dict, out=sys.stdout) -> None:
             )
 
 
+def diff_report(rep_a: dict, rep_b: dict) -> dict:
+    """The percentile-delta payload over two run reports: per histogram
+    present on either side, both rows plus ``delta`` (B minus A; None
+    when a side is missing); scalars likewise. Deterministic for fixed
+    inputs — golden-tested (tests/golden/telemetry_report_diff.json)."""
+    a_h = {h["name"]: h for h in rep_a["histograms"]}
+    b_h = {h["name"]: h for h in rep_b["histograms"]}
+    hists = []
+    cols = ["count", "mean_s"] + [f"p{p}_s" for p in PERCENTILES]
+    for name in sorted(a_h.keys() | b_h.keys()):
+        ha, hb = a_h.get(name), b_h.get(name)
+        delta = (
+            {c: round(hb[c] - ha[c], 6) for c in cols}
+            if ha is not None and hb is not None
+            else None
+        )
+        hists.append({"name": name, "a": ha, "b": hb, "delta": delta})
+    scalars = {}
+    sa, sb = rep_a["scalars"], rep_b["scalars"]
+    for name in sorted(sa.keys() | sb.keys()):
+        va, vb = sa.get(name), sb.get(name)
+        scalars[name] = {
+            "a": va,
+            "b": vb,
+            "delta": round(vb - va, 6)
+            if isinstance(va, (int, float)) and isinstance(vb, (int, float))
+            else None,
+        }
+    return {"histograms": hists, "scalars": scalars}
+
+
+def render_diff(rep: dict, out=None) -> None:
+    out = out if out is not None else sys.stdout
+    print("telemetry diff (B - A)", file=out)
+    rows = rep["histograms"]
+    if rows:
+        width = max(len(h["name"]) for h in rows)
+        cols = ["count"] + [f"p{p}_s" for p in PERCENTILES]
+        print(
+            f"\n  {'histogram':<{width}s} "
+            + " ".join(f"{'d_' + c:>12s}" for c in cols),
+            file=out,
+        )
+        for h in rows:
+            if h["delta"] is None:
+                side = "A only" if h["b"] is None else "B only"
+                print(f"  {h['name']:<{width}s} ({side})", file=out)
+                continue
+            print(
+                f"  {h['name']:<{width}s} "
+                + " ".join(
+                    f"{h['delta'][c]:+12d}" if c == "count"
+                    else f"{h['delta'][c]:+12.6f}"
+                    for c in cols
+                ),
+                file=out,
+            )
+    changed = {
+        k: v for k, v in rep["scalars"].items()
+        if v["delta"] not in (None, 0, 0.0)
+        or v["a"] is None or v["b"] is None
+    }
+    if changed:
+        print("\n  counters / gauges (changed):", file=out)
+        width = max(len(k) for k in changed)
+        for k, v in changed.items():
+            if v["a"] is None or v["b"] is None:
+                side = "A only" if v["b"] is None else "B only"
+                val = v["a"] if v["b"] is None else v["b"]
+                print(f"  {k:<{width}s} {val:g} ({side})", file=out)
+                continue
+            print(
+                f"  {k:<{width}s} {v['a']:g} -> {v['b']:g} "
+                f"({v['delta']:+g})",
+                file=out,
+            )
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    ap.add_argument("path", help="telemetry.jsonl to render")
+    ap.add_argument(
+        "path", nargs="?", default=None, help="telemetry.jsonl to render"
+    )
+    ap.add_argument(
+        "--diff", nargs=2, metavar=("A", "B"), default=None,
+        help="render the A→B percentile-delta table over two JSONLs",
+    )
     ap.add_argument(
         "--json", dest="json_out", default=None, metavar="PATH",
         help="also write the machine-readable report ('-' = stdout only)",
     )
     args = ap.parse_args(argv)
-    rep = report(load(args.path))
+    if args.diff is not None and args.path is not None:
+        ap.error("pass either a telemetry.jsonl path or --diff A B, not both")
+    if args.diff is not None:
+        rep = diff_report(
+            report(load(args.diff[0])), report(load(args.diff[1]))
+        )
+        renderer = render_diff
+    elif args.path is not None:
+        rep = report(load(args.path))
+        renderer = render
+    else:
+        ap.error("pass a telemetry.jsonl path or --diff A B")
     if args.json_out == "-":
         print(json.dumps(rep, indent=1))
         return 0
-    render(rep)
+    renderer(rep)
     if args.json_out:
         with open(args.json_out, "w") as fh:
             json.dump(rep, fh, indent=1)
