@@ -315,6 +315,142 @@ def lint_source(
     return findings
 
 
+# --------------------------------------------------------------------------
+# Robustness lint (ISSUE 9) — failure-semantics hygiene over PACKAGE code
+# (not just traced modules): the bug classes that turn recoverable faults
+# into silent corruption or livelock.
+# --------------------------------------------------------------------------
+
+#: Exception types whose pass-only swallow is an ERROR: catching
+#: everything and doing NOTHING hides torn writes, poison requests, and
+#: dead filesystems from every recovery path above it. Narrow types
+#: (OSError on a best-effort unlink) stay legal.
+_SWALLOW_WIDE = frozenset({"Exception", "BaseException"})
+
+#: A retry loop is "bounded or backing off" if it calls any of these —
+#: sleep/wait primitives or the unified policy's own surface
+#: (faults/retry.py delay/delays/call). Deliberately NOT "join": too
+#: common as str.join inside error formatting, which would exempt a
+#: genuine busy-spin.
+_BACKOFF_CALLS = frozenset({"sleep", "wait", "backoff", "delay", "delays", "call"})
+
+
+def _handler_type_names(handler: ast.ExceptHandler) -> list[str]:
+    t = handler.type
+    if t is None:
+        return ["<bare>"]
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    out = []
+    for e in elts:
+        if isinstance(e, ast.Name):
+            out.append(e.id)
+        elif isinstance(e, ast.Attribute):
+            out.append(e.attr)
+    return out
+
+
+def _body_only_pass(body: list[ast.stmt]) -> bool:
+    return all(
+        isinstance(s, ast.Pass)
+        or (
+            isinstance(s, ast.Expr)
+            and isinstance(s.value, ast.Constant)
+            and s.value.value is ...
+        )
+        for s in body
+    )
+
+
+def lint_robustness_source(
+    source: str, filename: str = "<source>"
+) -> list[Finding]:
+    """Failure-semantics lint over one module's source:
+
+    - **swallowed-exception** (error): ``except:`` / ``except Exception:``
+      / ``except BaseException:`` (alone or in a tuple) whose body is
+      only ``pass``/``...`` — the fault disappears with no log, no
+      counter, no typed completion. Handle it, log it, or narrow the
+      type.
+    - **unbounded-retry** (warning): a ``while True`` loop containing a
+      ``try`` whose handler neither re-raises nor breaks, with no
+      sleep/backoff/budget call anywhere in the loop — a dead dependency
+      turns it into a busy-spin that also never escalates. Adopt
+      ``faults/retry.py``.
+    """
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:  # pragma: no cover - repo sources parse
+        return [
+            Finding(
+                "robustness", "warning", "unparseable",
+                f"{filename}: {e}", {"file": filename},
+            )
+        ]
+    findings: list[Finding] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler):
+            wide = _SWALLOW_WIDE & set(_handler_type_names(node))
+            if wide or node.type is None:
+                if _body_only_pass(node.body):
+                    caught = (
+                        "bare except" if node.type is None
+                        else f"except {sorted(wide)[0]}"
+                    )
+                    findings.append(
+                        Finding(
+                            "robustness", "error", "swallowed-exception",
+                            f"{filename}:{node.lineno} {caught}: pass — "
+                            "the fault vanishes with no log, counter, or "
+                            "typed resolution; handle it, log it, or "
+                            "narrow the type",
+                            {"file": filename, "line": node.lineno},
+                        )
+                    )
+        elif isinstance(node, ast.While):
+            test = node.test
+            if not (isinstance(test, ast.Constant) and test.value is True):
+                continue
+            trys = [
+                s for s in ast.walk(node) if isinstance(s, ast.Try)
+            ]
+            if not trys:
+                continue
+            calls = set()
+            for c in ast.walk(node):
+                if isinstance(c, ast.Call):
+                    if isinstance(c.func, ast.Attribute):
+                        calls.add(c.func.attr)
+                    elif isinstance(c.func, ast.Name):
+                        calls.add(c.func.id)
+            if calls & _BACKOFF_CALLS:
+                continue
+            swallowing = any(
+                not any(
+                    isinstance(s, (ast.Raise, ast.Break, ast.Return))
+                    for s in ast.walk(h)
+                )
+                for t in trys
+                for h in t.handlers
+            )
+            if swallowing:
+                findings.append(
+                    Finding(
+                        "robustness", "warning", "unbounded-retry",
+                        f"{filename}:{node.lineno} while True retry loop "
+                        "with no backoff/budget call and an exception "
+                        "handler that never escalates — a dead dependency "
+                        "becomes a busy-spin; adopt faults/retry.py",
+                        {"file": filename, "line": node.lineno},
+                    )
+                )
+    return findings
+
+
+def lint_robustness_file(path: str) -> list[Finding]:
+    with open(path) as fh:
+        return lint_robustness_source(fh.read(), path)
+
+
 def lint_file(
     path: str,
     *,

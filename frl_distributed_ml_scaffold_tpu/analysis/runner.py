@@ -561,11 +561,41 @@ def lint_hygiene(paths: Iterable[str] | None = None) -> Report:
     return report
 
 
+def lint_robustness(paths: Iterable[str] | None = None) -> Report:
+    """Failure-semantics lint (ISSUE 9) over the WHOLE package — host
+    orchestration included, because that is exactly where exceptions get
+    swallowed and retry loops spin (the traced-module file list the
+    hygiene pass uses would miss the engine, the supervisor, and the
+    checkpointer)."""
+    import glob
+    import os
+
+    from frl_distributed_ml_scaffold_tpu.analysis.hygiene import (
+        lint_robustness_file,
+    )
+
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if paths is None:
+        paths = sorted(
+            p
+            for p in glob.glob(os.path.join(pkg, "**", "*.py"), recursive=True)
+            if "__pycache__" not in p
+        )
+    report = Report(program="robustness:package")
+    n = 0
+    for p in paths:
+        n += 1
+        report.extend(lint_robustness_file(p))
+    report.meta["files"] = n
+    return report
+
+
 def lint_all(
     *,
     recipes: Iterable[str] | None = None,
     serving: bool = True,
     hygiene: bool = True,
+    robustness: bool = True,
     workdir: str = "/tmp/graft_lint",
     budget_bytes: int | None = None,
     on_report: Callable[[Report], None] | None = None,
@@ -601,4 +631,6 @@ def lint_all(
         emit(lint_decode_step(kv_cache_quant="int8"))
     if hygiene:
         emit(lint_hygiene())
+    if robustness:
+        emit(lint_robustness())
     return reports

@@ -1,12 +1,32 @@
-"""Orbax-backed checkpoint manager (SURVEY C13, call stack (c))."""
+"""Orbax-backed checkpoint manager (SURVEY C13, call stack (c)).
+
+Crash consistency (ISSUE 9): a checkpoint only COUNTS once its commit
+marker exists. ``save`` writes the Orbax step dir, waits for the bytes
+(async saves commit at the next ``save``/``wait``/``close`` — the point
+where ``wait_until_finished`` proves them complete), then atomically
+publishes ``commits/step_<N>``. ``latest_step``/``all_steps`` judge only
+committed steps, so a write torn by a crash/preemption mid-serialization
+(step dir present, marker absent) is skipped instead of restored; and
+``restore_or_init`` additionally survives bit-rot a marker cannot see —
+a committed step that fails to restore is REPORTED (``corrupt_steps``,
+directory left in place for inspection, never deleted) and the restore
+falls back down the committed chain to the last good step. Directories
+written before the marker protocol (no ``commits/`` dir at all) are
+honored wholesale — the first new-protocol save backfills their markers
+(staged + one atomic rename) so they STAY committed once ``commits/``
+exists — and the exception-driven fallback is their safety net.
+"""
 
 from __future__ import annotations
 
+import os
+import shutil
 from typing import Any
 
 import jax
 import orbax.checkpoint as ocp
 
+from frl_distributed_ml_scaffold_tpu import faults
 from frl_distributed_ml_scaffold_tpu.config.schema import CheckpointConfig
 from frl_distributed_ml_scaffold_tpu.trainer.train_state import TrainState
 from frl_distributed_ml_scaffold_tpu.utils.logging import get_logger
@@ -16,10 +36,11 @@ class Checkpointer:
     """Async sharded save + resharding restore for a TrainState.
 
     ``restore_or_init(trainer)`` is the one entry the Trainer and the elastic
-    supervisor both use: if a checkpoint exists it restores **into the
-    trainer's current shardings** (which may correspond to a different
-    topology than the writer's — Orbax reshards from the abstract target
-    pytree); otherwise it initializes fresh.
+    supervisor both use: if a committed checkpoint exists it restores
+    **into the trainer's current shardings** (which may correspond to a
+    different topology than the writer's — Orbax reshards from the abstract
+    target pytree), falling back down the committed chain past torn or
+    corrupt steps; otherwise it initializes fresh.
     """
 
     def __init__(self, directory: str, cfg: CheckpointConfig):
@@ -33,20 +54,151 @@ class Checkpointer:
                 enable_async_checkpointing=cfg.async_save,
             ),
         )
+        self._commits_dir = os.path.join(directory, "commits")
+        # Steps already on disk in a directory the marker protocol has
+        # never touched: pre-protocol checkpoints, honored wholesale.
+        # Captured NOW so the first _commit can backfill their markers —
+        # without this, the first new-protocol save would flip every
+        # legacy step to "uncommitted" (and a torn write from THIS
+        # process, which happens after construction, stays unmarked).
+        self._legacy_steps: set[int] = (
+            set()
+            if os.path.isdir(self._commits_dir)
+            else {int(s) for s in self._mngr.all_steps()}
+        )
+        # Steps whose Orbax save was issued but not yet proven complete
+        # (async): committed at the next save/wait/close.
+        self._uncommitted: list[int] = []
+        #: Committed steps that failed to restore (bit rot, truncated
+        #: arrays): reported by restore_or_init, left on disk.
+        self.corrupt_steps: list[int] = []
+
+    # ------------------------------------------------------ commit markers
+
+    def _marker(self, step: int) -> str:
+        return os.path.join(self._commits_dir, f"step_{int(step)}")
+
+    def _commit(self, step: int) -> None:
+        """Atomically publish the marker (write-tmp + rename: a reader
+        either sees a complete marker or none). Only the primary process
+        writes — the marker's absence must mean "torn", never "written
+        by a rank that died first"."""
+        if jax.process_index() != 0:
+            return
+        if not os.path.isdir(self._commits_dir):
+            # First marker this directory has ever seen: backfill the
+            # pre-protocol steps (committed wholesale until now — they
+            # must STAY committed once commits/ exists) in a staged dir
+            # published with one atomic rename, so a crash anywhere in
+            # the transition leaves either no commits/ (legacy semantics
+            # intact) or a complete one — never an empty commits/ that
+            # orphans every existing checkpoint.
+            stage = self._commits_dir + f".tmp.{os.getpid()}"
+            shutil.rmtree(stage, ignore_errors=True)
+            os.makedirs(stage)
+            for s in self._legacy_steps | {int(step)}:
+                with open(os.path.join(stage, f"step_{int(s)}"), "w") as fh:
+                    fh.write(f"{int(s)}\n")
+            os.rename(stage, self._commits_dir)
+            return
+        tmp = self._marker(step) + f".tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            fh.write(f"{int(step)}\n")
+        os.replace(tmp, self._marker(step))
+
+    def _commit_pending(self) -> None:
+        """Publish markers for async saves now proven complete. The
+        caller has just returned from ``wait_until_finished`` — that is
+        the proof."""
+        for step in self._uncommitted:
+            self._commit(step)
+        self._uncommitted.clear()
+
+    def _has_commits_dir(self) -> bool:
+        return os.path.isdir(self._commits_dir)
+
+    def is_committed(self, step: int) -> bool:
+        """Committed = marker present; pre-marker-protocol directories
+        (no ``commits/`` dir ever created) count wholesale."""
+        if not self._has_commits_dir():
+            return True
+        return os.path.exists(self._marker(step))
+
+    def uncommitted_steps(self) -> list[int]:
+        """On-disk Orbax steps with no commit marker — torn writes (or a
+        save still in flight). Reported, never auto-deleted: operators
+        decide what a torn checkpoint's remains are worth."""
+        if not self._has_commits_dir():
+            return []
+        return [
+            s for s in sorted(self._mngr.all_steps())
+            if not os.path.exists(self._marker(s))
+        ]
+
+    # --------------------------------------------------------------- save
 
     def save(self, step: int, state: TrainState, *, force: bool = False) -> bool:
+        if self._uncommitted:
+            # Previous async saves: wait (Orbax serializes async saves
+            # anyway, so this wait is ~free by the time the next save is
+            # due) and publish their markers before starting new work.
+            self._mngr.wait_until_finished()
+            self._commit_pending()
         saved = self._mngr.save(
             step, args=ocp.args.StandardSave(state), force=force
         )
         if saved:
-            self.logger.info("checkpoint saved at step %d -> %s", step, self.directory)
+            if faults.fire("checkpoint.torn_write") is not None:
+                # Injected torn write: the step dir stays visible but a
+                # payload file is truncated and NO marker is published —
+                # exactly what a crash mid-serialization leaves behind.
+                self._mngr.wait_until_finished()
+                self._tear(step)
+                self.logger.warning(
+                    "fault injection: torn checkpoint write at step %d "
+                    "(file truncated, commit marker withheld)", step
+                )
+                return saved
+            if self.cfg.async_save:
+                self._uncommitted.append(step)
+            else:
+                self._commit(step)
+            self.logger.info(
+                "checkpoint saved at step %d -> %s", step, self.directory
+            )
         return saved
 
-    def latest_step(self) -> int | None:
-        return self._mngr.latest_step()
+    def _tear(self, step: int) -> None:
+        """Truncate the largest payload file under the step dir (the
+        injection shape of a mid-write crash)."""
+        step_dir = os.path.join(self.directory, str(int(step)))
+        victim, size = None, 0
+        for root, _, files in os.walk(step_dir):
+            for name in files:
+                p = os.path.join(root, name)
+                try:
+                    sz = os.path.getsize(p)
+                except OSError:
+                    continue
+                if sz > size:
+                    victim, size = p, sz
+        if victim is not None:
+            with open(victim, "r+b") as fh:
+                fh.truncate(size // 2)
 
-    def all_steps(self) -> list[int]:
-        return sorted(self._mngr.all_steps())
+    # ------------------------------------------------------------ queries
+
+    def latest_step(self) -> int | None:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def all_steps(self, *, include_uncommitted: bool = False) -> list[int]:
+        steps = sorted(self._mngr.all_steps())
+        if include_uncommitted or not self._has_commits_dir():
+            return steps
+        return [s for s in steps if os.path.exists(self._marker(s))]
+
+    # ---------------------------------------------------------- restores
 
     def restore_params_only(
         self, state_shapes: Any, state_shardings: Any, step: int
@@ -116,18 +268,16 @@ class Checkpointer:
         self.logger.info("restored checkpoint step %d from %s", step, self.directory)
         return restored
 
-    def restore_or_init(self, trainer) -> TrainState:
-        step = self.latest_step()
-        if step is None:
-            return trainer.init_state()
-        shapes, shardings = trainer.state_shapes, trainer.state_shardings
+    def _restore_bridging_ema(
+        self, shapes: Any, shardings: Any, step: int
+    ) -> TrainState:
+        """One step's restore, bridging an ema_decay toggle across the
+        resume (the checkpoint has/lacks the ema_params subtree relative
+        to the new run's target) — a corrupt step raises out of BOTH
+        attempts and the caller falls back down the chain."""
         try:
             return self.restore(shapes, shardings, step)
         except Exception:
-            # Structure mismatch happens when trainer.ema_decay was toggled
-            # across the resume: the checkpoint on disk has (or lacks) the
-            # ema_params subtree relative to the new run's target. Bridge
-            # both directions rather than aborting the resume.
             if shapes.ema_params is not None:
                 # New run wants EMA, checkpoint predates it: restore without
                 # the EMA subtree and seed it from the restored params.
@@ -161,8 +311,44 @@ class Checkpointer:
             )
             return state.replace(ema_params=None)
 
+    def restore_or_init(self, trainer) -> TrainState:
+        torn = self.uncommitted_steps()
+        if torn:
+            self.logger.warning(
+                "checkpoint dir %s holds uncommitted step(s) %s (torn "
+                "write or crash mid-save): skipping them; directories "
+                "left in place for inspection", self.directory, torn,
+            )
+        steps = self.all_steps()
+        shapes, shardings = trainer.state_shapes, trainer.state_shardings
+        for step in reversed(steps):
+            try:
+                return self._restore_bridging_ema(shapes, shardings, step)
+            except Exception as e:
+                # Bit rot / truncation a commit marker cannot see: report
+                # it, keep the directory for inspection, fall back to the
+                # previous committed step.
+                self.corrupt_steps.append(step)
+                self.logger.error(
+                    "checkpoint step %d is committed but unreadable "
+                    "(%s: %s); falling back to the previous committed "
+                    "step — directory left in place for inspection",
+                    step, type(e).__name__, e,
+                )
+        if steps:
+            self.logger.error(
+                "no committed checkpoint under %s was restorable "
+                "(%d tried); initializing fresh", self.directory, len(steps),
+            )
+        return trainer.init_state()
+
     def wait(self) -> None:
         self._mngr.wait_until_finished()
+        self._commit_pending()
 
     def close(self) -> None:
-        self._mngr.close()
+        try:
+            self._mngr.wait_until_finished()
+            self._commit_pending()
+        finally:
+            self._mngr.close()

@@ -214,6 +214,12 @@ class TrainerConfig:
     # TPU-only: the CPU sim backend cannot partition host-memory arrays
     # (the Trainer refuses with a clear error).
     offload_opt_state: bool = False
+    # Graceful preemption (SIGTERM → finish the in-flight step → save a
+    # synchronized checkpoint → exit rc 0): whether the preemption path
+    # SAVES before exiting. Off only for runs whose checkpoints are
+    # managed externally (the clean exit itself always happens — a
+    # preempted child must never die mid-collective).
+    preempt_save: bool = True
 
 
 @dataclass(frozen=True)
@@ -226,12 +232,45 @@ class CheckpointConfig:
 
 
 @dataclass(frozen=True)
+class ServingConfig:
+    """Serving-tier failure semantics (ISSUE 9, docs/operations.md
+    "Failure semantics"). These are the graceful-degradation knobs the
+    continuous-batching engine (serving/engine.py) takes at construction;
+    tools/serve_bench.py --chaos exercises them end-to-end."""
+
+    # Bounded admission: submits beyond this many queued (not yet
+    # admitted) requests are LOAD-SHED — the caller gets a typed
+    # completion (finish_reason="shed") immediately instead of unbounded
+    # queue growth eating host memory and blowing every SLO at once.
+    # 0 = unbounded (the pre-ISSUE-9 behavior).
+    max_queue_depth: int = 0
+    # Per-request deadline, seconds from submit: a request still queued
+    # past its deadline sheds at admission; one mid-decode is CANCELLED —
+    # retired with finish_reason="deadline" and the tokens generated so
+    # far, freeing the slot for refill. submit(deadline_s=...) overrides
+    # per request. 0 = no deadline.
+    default_deadline_s: float = 0.0
+
+
+@dataclass(frozen=True)
 class ElasticConfig:
     """Checkpoint-restart elasticity (SURVEY C14): the supervisor restarts a
     dead child up to ``max_restarts`` times with exponential backoff."""
 
     max_restarts: int = 3
     backoff_s: float = 1.0
+    # Backoff cap for the restart loop (the supervisor's retry budget is
+    # the faults/retry.py RetryPolicy: backoff_s * 2^(n-1), capped here,
+    # budgeted by max_restarts) — exponential backoff must not park a
+    # crash-looping host for hours.
+    max_backoff_s: float = 300.0
+    # Membership heartbeat writes that fail (shared-FS outage) are
+    # counted (heartbeat_write_failures_total) and retried each
+    # interval; after this many CONSECUTIVE failures the supervisor
+    # retires its membership record (unlinks it) so peers evict this
+    # host deterministically instead of racing the mtime staleness
+    # window. 0 = retry forever (the pre-ISSUE-9 behavior).
+    heartbeat_retire_after: int = 10
     # A child that survives this long before dying counts as real progress:
     # the restart budget and backoff reset (torchrun-elastic-agent semantics),
     # so a week-long run isn't killed by its 4th once-a-day preemption.
@@ -281,6 +320,13 @@ class DataConfig:
     # the module docstring. false = the corpus freezes at construction.
     streaming: bool = False
     streaming_refresh_every: int = 256
+    # Host-side batch-build failures (decode error, transient shared-FS
+    # read) are retried under the unified faults/retry.py policy — the
+    # batch is a pure function of step, so a rebuild is safe. After the
+    # budget the original exception propagates (a permanently bad shard
+    # must kill the run loudly, not spin).
+    loader_max_retries: int = 2
+    loader_retry_backoff_s: float = 0.05
 
 
 # --------------------------------------------------------------------------
@@ -478,6 +524,7 @@ class ExperimentConfig:
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
     precision: PrecisionConfig = field(default_factory=PrecisionConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    serving: ServingConfig = field(default_factory=ServingConfig)
     elastic: ElasticConfig = field(default_factory=ElasticConfig)
     workdir: str = "/tmp/frl_tpu_runs"
 

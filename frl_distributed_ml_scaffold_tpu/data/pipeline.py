@@ -14,8 +14,10 @@ from typing import Any, Callable, Iterator
 import jax
 import numpy as np
 
+from frl_distributed_ml_scaffold_tpu import faults
 from frl_distributed_ml_scaffold_tpu.config.schema import DataConfig
 from frl_distributed_ml_scaffold_tpu.dist.mesh import MeshEnv
+from frl_distributed_ml_scaffold_tpu.faults import RetryPolicy
 
 Batch = dict[str, np.ndarray]
 
@@ -69,9 +71,35 @@ class DataPipeline:
 
         self.local_batch_size = local_batch_size(cfg.global_batch_size, env)
         self._proc = jax.process_index()
+        # Loader hardening (ISSUE 9): the host-side batch build is a pure
+        # function of step, so a transient failure (decode error on a
+        # flaky FS read, a shard mid-replacement) is safely retried under
+        # the unified policy; the budget's last exception propagates —
+        # a permanently bad shard kills the run loudly.
+        self._retry = RetryPolicy(
+            max_retries=cfg.loader_max_retries,
+            backoff_s=cfg.loader_retry_backoff_s,
+            max_backoff_s=max(cfg.loader_retry_backoff_s * 8, 1e-9),
+        )
+        #: Total batch-build retries this pipeline performed (observable
+        #: fault ledger; tests + chaos drills read it).
+        self.loader_retries = 0
+
+    def _count_retry(self, attempt: int, exc: BaseException) -> None:
+        self.loader_retries += 1
 
     def local_batch(self, step: int) -> Batch:
-        return self.source.batch(step, self.local_batch_size, host_offset=self._proc)
+        def build() -> Batch:
+            faults.maybe_raise("data.loader", key=step)
+            return self.source.batch(
+                step, self.local_batch_size, host_offset=self._proc
+            )
+
+        return self._retry.call(
+            build,
+            describe=f"{self.split} batch(step={step})",
+            on_retry=self._count_retry,
+        )
 
     def global_batch(self, step: int) -> dict[str, jax.Array]:
         """Host batch -> device-committed sharded arrays. ``shardings_for``
@@ -156,6 +184,10 @@ class PrefetchingPipeline:
     @property
     def local_batch_size(self):
         return self._p.local_batch_size
+
+    @property
+    def loader_retries(self):
+        return self._p.loader_retries
 
     def shardings_for(self, batch):
         return self._p.shardings_for(batch)

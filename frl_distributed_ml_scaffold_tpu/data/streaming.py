@@ -231,7 +231,10 @@ class _WindowProtocol:
 #: bucket boundary: a transient NFS attribute-cache lag clears within a
 #: batch or two, but a permanently unservable window (rotated corpus,
 #: mid-run anchor mismatch) must not pay a directory scan + sync publish +
-#: warning line on EVERY batch for the rest of the run.
+#: warning line on EVERY batch for the rest of the run. A deliberate bare
+#: budget, not a faults/retry.py RetryPolicy: adoption is step-driven
+#: (the next batch IS the backoff), so the policy's sleeping machinery
+#: would never run — only its ``max_retries`` semantics apply.
 RETRY_BUDGET_PER_BUCKET = 8
 
 

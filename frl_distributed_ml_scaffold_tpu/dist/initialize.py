@@ -81,8 +81,13 @@ def _enable_cpu_collectives() -> None:
     releases). No-op on jax builds without the knob."""
     try:
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
-    except Exception:  # older/newer jax without the option: leave default
-        pass
+    except Exception as e:  # older/newer jax without the option: leave default
+        import logging
+
+        logging.getLogger(__name__).debug(
+            "jax_cpu_collectives_implementation unavailable (%s); "
+            "keeping the backend default", e,
+        )
 
 
 def process_count() -> int:

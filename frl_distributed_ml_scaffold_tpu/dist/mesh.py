@@ -107,8 +107,13 @@ def enable_sharding_invariant_rng() -> None:
         return
     try:
         jax.config.update("jax_threefry_partitionable", True)
-    except Exception:  # a jax without the flag already behaves this way
-        pass
+    except Exception as e:  # a jax without the flag already behaves this way
+        import logging
+
+        logging.getLogger(__name__).debug(
+            "jax_threefry_partitionable unavailable (%s); this jax "
+            "already defaults to the partitionable impl", e,
+        )
 
 
 def build_mesh(cfg: MeshConfig, devices=None) -> MeshEnv:

@@ -1,0 +1,117 @@
+"""Fault-injection harness + unified retry policy (ISSUE 9).
+
+``plan.py`` holds the mechanism (``FaultPlan``/``FaultSpec``, the named
+site registry); ``retry.py`` the one backoff policy every retrying tier
+adopts. This package root holds the AMBIENT plan: the tiers consult
+module-level hooks (``fire``/``maybe_raise``/``maybe_hang``) so deep call
+stacks (a Checkpointer constructed inside a Trainer inside a supervised
+child) need no plumbing — and the unarmed path is one ``None`` check.
+
+Arming:
+
+- in-process (tests, chaos benches): ``with faults.active(plan): ...``
+  or ``faults.install(plan)`` / ``faults.install(None)``;
+- cross-process (elastic supervision drills): the ``FRL_FAULT_PLAN`` env
+  var (JSON — see ``FaultPlan.from_env``), read lazily on the first
+  consultation in the child. Note the occurrence counters (``at``) are
+  per-process: a restarted child re-counts from zero, so supervised
+  drills that must fire exactly once still use the workdir-marker
+  one-shot (``launcher/elastic.py``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import Any, Iterator, Optional
+
+from frl_distributed_ml_scaffold_tpu.faults.plan import (
+    KNOWN_SITES,
+    FaultPlan,
+    FaultSpec,
+)
+from frl_distributed_ml_scaffold_tpu.faults.retry import RetryPolicy
+
+__all__ = [
+    "KNOWN_SITES",
+    "FaultPlan",
+    "FaultSpec",
+    "RetryPolicy",
+    "active",
+    "current_plan",
+    "fire",
+    "install",
+    "maybe_hang",
+    "maybe_raise",
+]
+
+_PLAN: Optional[FaultPlan] = None
+_ENV_READ = False
+
+
+def install(plan: Optional[FaultPlan]) -> Optional[FaultPlan]:
+    """Install ``plan`` as the ambient plan (``None`` disarms); returns
+    the previous plan so callers can restore it."""
+    global _PLAN, _ENV_READ
+    prev = _PLAN
+    _PLAN = plan
+    # An explicit install (including disarm) overrides the env path for
+    # the rest of the process — tests must never inherit a stray env plan.
+    _ENV_READ = True
+    return prev
+
+
+@contextlib.contextmanager
+def active(plan: FaultPlan) -> Iterator[FaultPlan]:
+    """Scoped arming: ``with faults.active(plan): ...``."""
+    prev = install(plan)
+    try:
+        yield plan
+    finally:
+        install(prev)
+
+
+def current_plan() -> Optional[FaultPlan]:
+    global _ENV_READ, _PLAN
+    if not _ENV_READ:
+        _ENV_READ = True
+        spec = os.environ.get("FRL_FAULT_PLAN")
+        if spec:
+            _PLAN = FaultPlan.from_env(spec)
+    return _PLAN
+
+
+def fire(site: str, key: Any = "") -> Optional[FaultSpec]:
+    """Consult the ambient plan at ``site``; ``None`` when unarmed (the
+    fast path every production step takes)."""
+    plan = _PLAN if _ENV_READ else current_plan()
+    if plan is None:
+        return None
+    return plan.fire(site, str(key))
+
+
+def maybe_raise(
+    site: str,
+    exc: type = RuntimeError,
+    *,
+    key: Any = "",
+    msg: str | None = None,
+) -> None:
+    """Raise ``exc`` when the site fires — the injection shape for sites
+    whose real failure is an exception (loader errors, heartbeat OSError,
+    poison prefill, grow allocation failure)."""
+    spec = fire(site, key)
+    if spec is not None:
+        raise exc(msg or f"injected fault: {site}" + (f" key={key}" if str(key) else ""))
+
+
+def maybe_hang(site: str, *, key: Any = "") -> bool:
+    """Sleep ``spec.arg`` seconds when the site fires (a hung/slow step);
+    returns whether it fired."""
+    spec = fire(site, key)
+    if spec is None:
+        return False
+    if spec.arg > 0:
+        time.sleep(spec.arg)
+    return True

@@ -1,0 +1,221 @@
+"""Deterministic fault injection: the ``FaultPlan`` registry (ISSUE 9).
+
+At the north-star scale (millions of users, pod-scale training),
+preemptions, torn checkpoint writes, hung steps, and poison requests are
+ROUTINE operating conditions — the only way to keep the recovery paths
+honest is to exercise them on demand, reproducibly, in tests and chaos
+benches. This module is the one registry those drills go through:
+
+- **Sites, not callbacks.** Every injectable failure is a NAMED site
+  (``KNOWN_SITES``); the trainer, serving engine, checkpointer, data
+  pipeline, and elastic membership each consult their site with a cheap
+  host-side hook (``faults.fire(site)`` — a dict lookup + ``None`` check
+  when unarmed). Unknown site names are refused at plan construction, so
+  a typo'd chaos spec fails loudly instead of silently injecting
+  nothing.
+- **Deterministic.** A spec fires on the ``at``-th matching consultation
+  (1-based) for ``times`` consecutive consultations (``times=0`` = every
+  one from ``at``); optional probabilistic firing (``p < 1``) draws from
+  a ``random.Random(seed)`` owned by the plan — same seed, same chaos.
+  Wall clock never participates.
+- **Counted.** Every injection increments ``fault_injected_total`` plus
+  a per-site counter on the plan's registry (when given) and the plan's
+  own ``injected`` tally — a chaos run's report can always say exactly
+  what was injected, and the tiers separately count what they OBSERVED
+  (``serve_shed_total``, ``heartbeat_write_failures_total``, ...); the
+  injected-vs-observed diff is the detection gap.
+
+The ambient plan (installed via ``faults.install`` / the ``active``
+context manager, or the ``FRL_FAULT_PLAN`` env var for child processes)
+lives in ``faults/__init__.py``; this module is the mechanism.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import random
+import threading
+from typing import Any, Iterable, Optional
+
+#: The injectable sites. One name per failure class in the fault matrix
+#: (docs/operations.md "Failure semantics"); adding a site here is the
+#: contract that some tier consults it and tests/test_faults.py pins
+#: both its detection and its recovery.
+KNOWN_SITES = frozenset(
+    {
+        # checkpoint/manager.py: save completes on disk but the write is
+        # torn (a file truncated, no commit marker) — the crash-mid-write
+        # shape restore must skip.
+        "checkpoint.torn_write",
+        # data/pipeline.py: the host-side batch build raises (decode
+        # error, bad shard, transient FS) — retried under faults/retry.py.
+        "data.loader",
+        # trainer/loop.py: one step's host loop hangs for ``arg`` seconds
+        # (a wedged collective / data loader) — the stall watchdog's prey.
+        "trainer.hung_step",
+        # trainer/loop.py: deliver SIGTERM to ourselves (a TPU maintenance
+        # preemption) — drives the checkpoint-and-exit-clean path.
+        "trainer.preempt",
+        # launcher/elastic.py child: hard os._exit after a step (the
+        # SIGKILL moral equivalent) — drives the supervisor restart path.
+        "child.hard_exit",
+        # serving/engine.py: a request's prefill raises (poison request).
+        "serve.prefill",
+        # serving/engine.py: growing the KV cache to the next bucket
+        # fails (allocation failure at high occupancy).
+        "serve.grow",
+        # launcher/elastic.py: a membership heartbeat write raises OSError
+        # (shared-FS outage) — drives the counted-retirement path.
+        "elastic.heartbeat_write",
+    }
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One armed injection: fire at the ``at``-th matching consultation.
+
+    ``key`` narrows matching to consultations carrying the same key (the
+    sites define what a key is — the serving engine passes the request
+    id, the data pipeline the step); ``""`` matches every consultation.
+    ``arg`` is the site-specific payload (hang seconds for
+    ``trainer.hung_step``; unused elsewhere).
+    """
+
+    site: str
+    at: int = 1
+    times: int = 1  # 0 = every consultation from ``at`` on
+    p: float = 1.0
+    arg: float = 0.0
+    key: str = ""
+
+    def __post_init__(self):
+        if self.site not in KNOWN_SITES:
+            raise ValueError(
+                f"unknown fault site {self.site!r} (known: "
+                f"{sorted(KNOWN_SITES)}) — a typo'd chaos spec would "
+                "otherwise silently inject nothing"
+            )
+        if self.at < 1:
+            raise ValueError(f"fault {self.site}: at={self.at} < 1 (1-based)")
+        if self.times < 0:
+            raise ValueError(f"fault {self.site}: times={self.times} < 0")
+        if not 0.0 < self.p <= 1.0:
+            raise ValueError(f"fault {self.site}: p={self.p} outside (0, 1]")
+
+
+def _counter_name(site: str) -> str:
+    return f"fault_injected_{site.replace('.', '_')}_total"
+
+
+class FaultPlan:
+    """A seeded set of ``FaultSpec``s consulted via ``fire``.
+
+    Thread-safe (the engine's watchdog thread, the prefetch worker, and
+    the elastic heartbeat thread all consult concurrently); cheap when a
+    site has no specs (one lock-free dict lookup).
+    """
+
+    def __init__(
+        self,
+        specs: Iterable[FaultSpec | dict],
+        *,
+        seed: int = 0,
+        registry: Any | None = None,
+    ):
+        parsed = [
+            s if isinstance(s, FaultSpec) else FaultSpec(**s) for s in specs
+        ]
+        self._by_site: dict[str, list[FaultSpec]] = {}
+        for s in parsed:
+            self._by_site.setdefault(s.site, []).append(s)
+        self.seed = int(seed)
+        self._rng = random.Random(self.seed)
+        self._lock = threading.Lock()
+        # Per-spec count of MATCHING consultations (the occurrence index
+        # ``at`` indexes into) — keyed by spec identity, not site, so two
+        # specs on one site count independently.
+        self._matches: dict[int, int] = {}
+        #: site -> injections fired (the plan's own ledger; always kept,
+        #: registry or not, so chaos tests can assert without telemetry).
+        self.injected: dict[str, int] = {}
+        self._registry = registry
+        self._m_total = (
+            registry.counter(
+                "fault_injected_total",
+                help="fault-plan injections fired, all sites",
+            )
+            if registry is not None
+            else None
+        )
+        self._m_site: dict[str, Any] = {}
+        if registry is not None:
+            # Register every armed site's counter up front: the catalog
+            # contract (a site that never fired scrapes as 0 — itself a
+            # signal that the drill did not reach it).
+            for site in self._by_site:
+                self._m_site[site] = registry.counter(
+                    _counter_name(site),
+                    help=f"injections fired at fault site {site}",
+                )
+
+    @classmethod
+    def from_env(
+        cls, value: str, *, registry: Any | None = None
+    ) -> "FaultPlan":
+        """Parse the ``FRL_FAULT_PLAN`` JSON: either a list of spec
+        objects or ``{"seed": ..., "specs": [...]}``."""
+        try:
+            data = json.loads(value)
+        except ValueError as e:
+            raise ValueError(
+                f"FRL_FAULT_PLAN is not valid JSON ({e}): {value!r}"
+            ) from None
+        if isinstance(data, dict):
+            seed = int(data.get("seed", 0))
+            specs = data.get("specs", [])
+        else:
+            seed, specs = 0, data
+        if not isinstance(specs, list):
+            raise ValueError(
+                f"FRL_FAULT_PLAN specs must be a list, got {type(specs).__name__}"
+            )
+        return cls(specs, seed=seed, registry=registry)
+
+    @property
+    def sites(self) -> list[str]:
+        return sorted(self._by_site)
+
+    def fire(self, site: str, key: str = "") -> Optional[FaultSpec]:
+        """Consult ``site``; returns the firing spec (the caller applies
+        its effect) or ``None``. The no-spec path is one dict lookup."""
+        specs = self._by_site.get(site)
+        if not specs:
+            return None
+        with self._lock:
+            # EVERY matching spec observes this consultation (the
+            # independent-counting contract above) — an early return
+            # would make a stacked plan's later windows fire late.
+            fired: Optional[FaultSpec] = None
+            for spec in specs:
+                if spec.key and spec.key != str(key):
+                    continue
+                sid = id(spec)
+                n = self._matches.get(sid, 0) + 1
+                self._matches[sid] = n
+                if n < spec.at:
+                    continue
+                if spec.times and n >= spec.at + spec.times:
+                    continue
+                if spec.p < 1.0 and self._rng.random() >= spec.p:
+                    continue
+                if fired is None:
+                    fired = spec
+            if fired is None:
+                return None
+            self.injected[site] = self.injected.get(site, 0) + 1
+            if self._m_total is not None:
+                self._m_total.inc()
+                self._m_site[site].inc()
+            return fired

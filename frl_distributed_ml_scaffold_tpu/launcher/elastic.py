@@ -30,7 +30,9 @@ import threading
 import time
 from typing import Callable, Optional
 
+from frl_distributed_ml_scaffold_tpu import faults
 from frl_distributed_ml_scaffold_tpu.config.schema import ExperimentConfig
+from frl_distributed_ml_scaffold_tpu.faults import RetryPolicy
 from frl_distributed_ml_scaffold_tpu.utils.logging import get_logger
 
 #: Exit code the fault-injection hook dies with (distinguishable from real
@@ -135,6 +137,19 @@ class _Membership:
             if registry is not None
             else None
         )
+        # ISSUE 9: failed heartbeat writes used to log-and-retry silently
+        # forever; now they are counted, and after N consecutive failures
+        # the record is retired (see start()) so peers evict this host
+        # deterministically instead of racing the staleness window.
+        self._m_hb_failures = (
+            registry.counter(
+                "heartbeat_write_failures_total",
+                help="membership heartbeat writes that raised (shared-FS "
+                     "outage); consecutive failures retire the record",
+            )
+            if registry is not None
+            else None
+        )
 
     def beat(self) -> None:
         with self._beat_lock:
@@ -146,6 +161,7 @@ class _Membership:
                 # peer_timeout_s and could preempt healthy children over
                 # it.
                 return
+            faults.maybe_raise("elastic.heartbeat_write", OSError)
             os.makedirs(self.dir, exist_ok=True)
             tmp = self.path + ".tmp"
             with open(tmp, "w") as fh:
@@ -159,21 +175,58 @@ class _Membership:
                 )
             os.replace(tmp, self.path)  # atomic: no torn reads
 
-    def start(self, interval_s: float) -> None:
+    def start(self, interval_s: float, retire_after: int = 10) -> None:
+        """Start the heartbeat thread. A transient shared-FS blip (NFS
+        hiccup, ENOSPC) must not kill the thread for good — a silently
+        dead heartbeat gets this healthy host shrunk OUT of the world by
+        its peers — so failures are logged, COUNTED
+        (``heartbeat_write_failures_total``), and retried next interval.
+        But ``retire_after`` (``elastic.heartbeat_retire_after``)
+        CONSECUTIVE failures mean the FS is gone for this host, not
+        blinking: the record is retired (unlinked, best-effort) so peers
+        evict it deterministically — absent reads as departed, exactly
+        like the clean ``retire()`` path — instead of every peer racing
+        the mtime staleness window at a slightly different moment
+        (ISSUE 9). The INITIAL beat still raises to the caller: at
+        startup there is no healthy history to protect, so an unwritable
+        membership dir is a misconfiguration that must crash the
+        supervisor loudly, not degrade into a silent peer-side
+        eviction."""
         self.beat()
 
         def loop() -> None:
+            failures = 0
             while not self._stop.wait(interval_s):
                 try:
                     self.beat()
+                    failures = 0
                 except OSError as e:
-                    # A transient shared-FS blip (NFS hiccup, ENOSPC) must
-                    # not kill the thread for good: a silently dead
-                    # heartbeat gets this healthy host shrunk OUT of the
-                    # world by its peers. Log and retry next interval.
+                    failures += 1
+                    if self._m_hb_failures is not None:
+                        self._m_hb_failures.inc()
                     get_logger().warning(
-                        "elastic: heartbeat write failed (%s); retrying", e
+                        "elastic: heartbeat write failed (%s); %d/%s "
+                        "consecutive", e, failures,
+                        retire_after if retire_after else "inf",
                     )
+                    if retire_after and failures >= retire_after:
+                        get_logger().error(
+                            "elastic: %d consecutive heartbeat-write "
+                            "failures — retiring membership record for "
+                            "uid %d so peers evict deterministically",
+                            failures, self.uid,
+                        )
+                        self._stop.set()
+                        with self._beat_lock:
+                            try:
+                                os.remove(self.path)
+                            except OSError as rm_err:
+                                get_logger().warning(
+                                    "elastic: could not unlink retired "
+                                    "heartbeat (%s); peers will fall back "
+                                    "to the staleness window", rm_err,
+                                )
+                        return
 
         self._thread = threading.Thread(
             target=loop, name="elastic-heartbeat", daemon=True
@@ -412,10 +465,18 @@ def supervise(args, cfg: ExperimentConfig) -> int:
                 os.path.join(cfg.workdir, cfg.name), uid, endpoint,
                 registry=telem,
             )
-            membership.start(interval_s=heartbeat_interval)
+            membership.start(
+                interval_s=heartbeat_interval,
+                retire_after=cfg.elastic.heartbeat_retire_after,
+            )
 
     initial_world = world
     m_world.set(world)
+    restart_retry = RetryPolicy(
+        max_retries=cfg.elastic.max_restarts,
+        backoff_s=cfg.elastic.backoff_s,
+        max_backoff_s=cfg.elastic.max_backoff_s,
+    )
     restarts = 0
     consecutive_failures = 0
     #: Budget-free restarts granted after a grow commit: a partially
@@ -633,7 +694,10 @@ def supervise(args, cfg: ExperimentConfig) -> int:
             restarts += 1
             m_restarts.inc()
             export_telemetry()
-            delay = cfg.elastic.backoff_s * (2 ** (restarts - 1))
+            # The unified retry policy (faults/retry.py, ISSUE 9):
+            # exponential from elastic.backoff_s, capped at
+            # elastic.max_backoff_s, budgeted by elastic.max_restarts.
+            delay = restart_retry.delay(restarts)
             logger.warning(
                 "elastic: child died rc=%d after %.1fs; restart %d/%d in "
                 "%.1fs (resume from last checkpoint)",
@@ -668,16 +732,32 @@ def supervise(args, cfg: ExperimentConfig) -> int:
 def fault_hook_from_env(
     cfg: ExperimentConfig,
 ) -> Optional[Callable[[int, dict], None]]:
-    """``on_step`` hook that hard-kills the process after a designated step.
+    """``on_step`` hook that kills the process after a designated step.
 
     ``FRL_FAULT_AT_STEP=N`` → die after completing step N (0-indexed step
-    N-1 in the loop, i.e. when ``step + 1 == N``). A marker file in the
-    workdir makes the fault one-shot so the restarted child survives even
-    when it resumes from a checkpoint before the fault step.
+    N-1 in the loop, i.e. when ``step + 1 == N``). The kill shape is
+    ``FRL_FAULT_SIGNAL``: unset/``KILL`` → ``os._exit`` (no checkpoint
+    flush, no atexit — the SIGKILL moral equivalent, driving the
+    supervisor's restart-from-last-checkpoint path); ``TERM`` → SIGTERM
+    to ourselves (a TPU maintenance preemption — the trainer's graceful
+    handler finishes the step, checkpoints, exits rc 0). A marker file in
+    the workdir makes the fault one-shot so the restarted child survives
+    even when it resumes from a checkpoint before the fault step.
+
+    The in-process fault sites (``faults/plan.py`` ``trainer.*``/
+    ``serve.*``/... sites) are the test/chaos-bench surface; this env
+    hook is the CROSS-PROCESS one the supervised-child drills need —
+    occurrence counters reset per process, the workdir marker does not.
     """
     delay_s = float(os.environ.get("FRL_STEP_DELAY_S", "0") or 0)
     spec = os.environ.get("FRL_FAULT_AT_STEP")
     fault_step = int(spec) if spec else 0
+    fault_signal = (os.environ.get("FRL_FAULT_SIGNAL") or "KILL").upper()
+    if fault_signal not in ("KILL", "TERM"):
+        raise ValueError(
+            f"FRL_FAULT_SIGNAL={fault_signal!r}: want KILL (hard exit) "
+            "or TERM (graceful preemption)"
+        )
     marker = os.path.join(cfg.workdir, cfg.name, "fault_injected")
     if fault_step and os.path.exists(marker):
         fault_step = 0
@@ -696,6 +776,15 @@ def fault_hook_from_env(
             os.makedirs(os.path.dirname(marker), exist_ok=True)
             with open(marker, "w") as fh:
                 fh.write(str(fault_step))
+            if fault_signal == "TERM":
+                logger.warning(
+                    "fault injection: SIGTERM self-preemption after "
+                    "step %d (graceful checkpoint-and-exit path)",
+                    fault_step,
+                )
+                sys.stdout.flush()
+                os.kill(os.getpid(), signal.SIGTERM)
+                return
             logger.warning(
                 "fault injection: hard-exit(%d) after step %d",
                 FAULT_EXIT_CODE,

@@ -9,9 +9,10 @@ retirement, and power-of-two cache buckets (serving/engine.py).
 """
 
 from frl_distributed_ml_scaffold_tpu.serving.engine import (
+    CacheGrowError,
     Completion,
     ServeRequest,
     ServingEngine,
 )
 
-__all__ = ["Completion", "ServeRequest", "ServingEngine"]
+__all__ = ["CacheGrowError", "Completion", "ServeRequest", "ServingEngine"]

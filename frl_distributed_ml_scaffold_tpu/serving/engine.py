@@ -42,6 +42,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from frl_distributed_ml_scaffold_tpu import faults
+from frl_distributed_ml_scaffold_tpu.config.schema import ServingConfig
 from frl_distributed_ml_scaffold_tpu.models.generation import (
     _decode_step,
     _plain_stack,
@@ -61,6 +63,14 @@ from frl_distributed_ml_scaffold_tpu.telemetry import (
 )
 
 
+class CacheGrowError(RuntimeError):
+    """Growing the KV cache to the next bucket failed (allocation failure
+    at high occupancy, or the ``serve.grow`` fault site). The engine
+    degrades instead of dying: requests that NEED the larger bucket are
+    retired with ``finish_reason="error"``; requests that still fit keep
+    decoding (see ``ServingEngine.step``)."""
+
+
 @dataclasses.dataclass
 class ServeRequest:
     """One queued generation request (prompt is an unpadded 1-D int array).
@@ -68,7 +78,8 @@ class ServeRequest:
     ``trace``/``span``/``t_submit`` are the tracing handles (ISSUE 8):
     every request gets its own trace id at enqueue, and the root
     ``request`` span stays open from submit to retire so the exported
-    trace reads as one connected tree per request."""
+    trace reads as one connected tree per request. ``deadline_s`` is the
+    submit-relative deadline (0 = none; ISSUE 9)."""
 
     id: int
     prompt: np.ndarray
@@ -76,6 +87,7 @@ class ServeRequest:
     trace: int = 0
     t_submit: float = 0.0
     span: Any = None
+    deadline_s: float = 0.0
 
 
 @dataclasses.dataclass
@@ -86,16 +98,32 @@ class Completion:
     token — the prefill) and p50/p99 time-per-output-token over the
     decode steps, computed through the telemetry histogram's log2-bucket
     quantile estimator so per-request numbers and the engine's aggregate
-    ``serve_tpot_seconds`` histogram read on the same scale."""
+    ``serve_tpot_seconds`` histogram read on the same scale.
+
+    ``finish_reason`` is the TYPED failure contract (ISSUE 9): every
+    submitted request resolves to exactly one completion —
+    ``"eos"``/``"length"`` (served in full), ``"shed"`` (load-shed at
+    admission: queue bound hit, no tokens generated), ``"deadline"``
+    (deadline passed — queued requests shed before prefill, mid-decode
+    requests are cancelled carrying the tokens generated so far), or
+    ``"error"`` (poison request quarantined / cache growth failed; any
+    tokens generated before the fault are carried). A caller therefore
+    never hangs on a faulted request and can always tell a served answer
+    from a degraded one."""
 
     id: int
     tokens: np.ndarray  # [prompt_len + n_generated]
     prompt_len: int
-    finish_reason: str  # "eos" | "length"
+    finish_reason: str  # "eos" | "length" | "shed" | "deadline" | "error"
     token_latencies_s: list[float]
     ttft_s: float = 0.0
     tpot_p50_s: float = 0.0
     tpot_p99_s: float = 0.0
+
+    @property
+    def ok(self) -> bool:
+        """Served in full (not shed / expired / quarantined)."""
+        return self.finish_reason in ("eos", "length")
 
 
 def _log2_quantiles(vals, qs) -> list[float]:
@@ -143,6 +171,9 @@ class ServingEngine:
         top_p: float = 0.0,
         rng: jax.Array | None = None,
         min_bucket: int = 8,
+        serving: ServingConfig | None = None,
+        max_queue_depth: int = 0,
+        default_deadline_s: float = 0.0,
         telemetry: MetricsRegistry | None = None,
         tracer: Tracer | None = None,
         stall_timeout_s: float = 0.0,
@@ -164,6 +195,22 @@ class ServingEngine:
         self._rng = jax.random.key(0) if rng is None else rng
         self.min_bucket = int(min_bucket)
         self.seq_len = model.config.seq_len
+        # Graceful degradation (ISSUE 9): `serving=` takes the whole
+        # ServingConfig (the `serving.*` section of an ExperimentConfig)
+        # — THE config-driven path; the scalar kwargs remain for callers
+        # without a config. Passing both is a caller bug, refused.
+        if serving is not None:
+            if max_queue_depth or default_deadline_s:
+                raise ValueError(
+                    "pass either serving=ServingConfig(...) or the "
+                    "max_queue_depth/default_deadline_s scalars, not both"
+                )
+            max_queue_depth = serving.max_queue_depth
+            default_deadline_s = serving.default_deadline_s
+        if max_queue_depth < 0:
+            raise ValueError(f"max_queue_depth={max_queue_depth} < 0")
+        self.max_queue_depth = int(max_queue_depth)
+        self.default_deadline_s = float(default_deadline_s)
 
         # The mesh is captured ONCE: every jitted program traces under it,
         # so replicated and sharded engines never share a trace.
@@ -172,6 +219,11 @@ class ServingEngine:
         self._env = current_mesh_env()
 
         self._queue: collections.deque[ServeRequest] = collections.deque()
+        # Typed completions produced OUTSIDE a slot (shed at submit,
+        # deadline-expired while queued, quarantined at admission) wait
+        # here until the next step()/run() returns them — a faulted
+        # request always resolves, never hangs.
+        self._early: list[Completion] = []
         self._next_id = 0
         self._issued_ids: set[int] = set()
         # Host-side slot state.
@@ -252,6 +304,25 @@ class ServingEngine:
         self._m_completed = t.counter(
             "serve_completed_total", help="requests finished"
         )
+        # Failure-semantics counters (ISSUE 9): the OBSERVED side of the
+        # fault ledger — chaos drills diff these against the FaultPlan's
+        # injected counts to prove detection.
+        self._m_shed = t.counter(
+            "serve_shed_total",
+            help="requests load-shed at submit (queue bound)",
+        )
+        self._m_deadline = t.counter(
+            "serve_deadline_miss_total",
+            help="requests past deadline (shed queued / cancelled decoding)",
+        )
+        self._m_quarantined = t.counter(
+            "serve_quarantined_total",
+            help="poison requests whose prefill failed (batch kept alive)",
+        )
+        self._m_grow_failures = t.counter(
+            "serve_grow_failures_total",
+            help="cache bucket growths that failed (degraded, not fatal)",
+        )
         self.watchdog = StallWatchdog(
             stall_timeout_s,
             name="serve",
@@ -279,8 +350,20 @@ class ServingEngine:
     # ----------------------------------------------------------- frontend
 
     def submit(
-        self, prompt, max_new_tokens: int, request_id: int | None = None
+        self,
+        prompt,
+        max_new_tokens: int,
+        request_id: int | None = None,
+        *,
+        deadline_s: float | None = None,
     ) -> int:
+        """Enqueue a request; returns its id. ``deadline_s`` (seconds
+        from now; ``None`` = the engine's ``default_deadline_s``, 0 = no
+        deadline) bounds the request's total latency — see
+        ``Completion.finish_reason`` for the typed outcomes. Malformed
+        requests still raise here (caller bugs), but LOAD conditions
+        (queue full) come back as a typed ``"shed"`` completion, so a
+        client library can treat overload as data, not control flow."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("empty prompt")
@@ -304,6 +387,9 @@ class ServingEngine:
         self._issued_ids.add(rid)
         self._next_id = max(self._next_id, rid) + 1
         req = ServeRequest(rid, prompt, int(max_new_tokens))
+        req.deadline_s = (
+            self.default_deadline_s if deadline_s is None else float(deadline_s)
+        )
         # Trace-id propagation contract: the id is born HERE, at enqueue,
         # and every span this request generates (queue_wait, prefill,
         # graft, decode ticks, retire) carries it — the root "request"
@@ -318,8 +404,45 @@ class ServingEngine:
         # from t_submit, so it must start exactly where the root does or
         # the tree's containment invariant breaks by a few microseconds.
         req.t_submit = getattr(req.span, "t0", None) or time.perf_counter()
+        # Bounded admission (ISSUE 9): beyond max_queue_depth QUEUED
+        # requests, shed typed instead of growing the queue without
+        # bound — active slots are not counted (they already have their
+        # memory), so the bound is exactly "work not yet started".
+        if self.max_queue_depth and len(self._queue) >= self.max_queue_depth:
+            self._m_shed.inc()
+            self._complete_unadmitted(req, "shed")
+            return rid
         self._queue.append(req)
         return rid
+
+    def _complete_unadmitted(self, req: ServeRequest, reason: str) -> None:
+        """Resolve a request that never occupied a slot (shed / expired
+        in queue / quarantined at admission) with a typed completion: the
+        prompt comes back untouched, zero generated tokens, and the root
+        span closes so the trace tree still reads enqueue→resolution."""
+        comp = Completion(
+            id=req.id,
+            tokens=req.prompt.copy(),
+            prompt_len=int(req.prompt.size),
+            finish_reason=reason,
+            token_latencies_s=[],
+        )
+        self._early.append(comp)
+        self.stats["completed"] += 1
+        self.stats[f"finish_{reason}"] += 1
+        self._m_completed.inc()
+        self._phase(
+            "retire", t0=time.perf_counter(), dur_s=0.0,
+            trace=req.trace, parent=req.span,
+            request=req.id, reason=reason, n_tokens=0,
+        )
+        req.span.end(finish_reason=reason, n_tokens=0)
+
+    def _expired(self, req: ServeRequest, now: float | None = None) -> bool:
+        if not req.deadline_s:
+            return False
+        now = time.perf_counter() if now is None else now
+        return now - req.t_submit > req.deadline_s
 
     @property
     def pending(self) -> int:
@@ -365,7 +488,9 @@ class ServingEngine:
         self.tracing.write_chrome_trace(path)
 
     def run(self, max_steps: int | None = None) -> list[Completion]:
-        """Drain the queue; returns completions in finish order."""
+        """Drain the queue; returns completions in finish order (typed
+        shed/deadline/error completions included — every submitted id
+        resolves exactly once, the never-hangs contract)."""
         out: list[Completion] = []
         steps = 0
         while self.pending:
@@ -373,6 +498,10 @@ class ServingEngine:
             steps += 1
             if max_steps is not None and steps >= max_steps:
                 break
+        # Requests resolved without ever entering a slot (e.g. every
+        # submit shed on a full queue) never pass through step().
+        out.extend(self._early)
+        self._early.clear()
         return out
 
     # ------------------------------------------------------ jitted shapes
@@ -490,10 +619,27 @@ class ServingEngine:
         return jax.tree.map(leaf, slot_cache)
 
     def _ensure_bucket(self, needed: int) -> None:
+        """Grow the cache to cover ``needed`` tokens; raises
+        ``CacheGrowError`` (counted) when the pad allocation fails — the
+        callers degrade per-request instead of crashing the engine."""
         target = self._bucket_for(needed)
         if target > self.bucket:
             t0 = time.perf_counter()
-            self.cache = self._grow_fn(self.bucket, target)(self.cache)
+            try:
+                faults.maybe_raise(
+                    "serve.grow", CacheGrowError,
+                    msg=f"injected grow failure {self.bucket}->{target}",
+                )
+                grown = self._grow_fn(self.bucket, target)(self.cache)
+            except Exception as e:
+                self._m_grow_failures.inc()
+                self.stats["grow_failures"] += 1
+                if isinstance(e, CacheGrowError):
+                    raise
+                raise CacheGrowError(
+                    f"cache grow {self.bucket}->{target} failed: {e}"
+                ) from e
+            self.cache = grown
             self.stats[f"grow_{self.bucket}->{target}"] += 1
             self._m_grows.inc()
             # Grows belong to the ENGINE lane, not any one request: the
@@ -509,21 +655,46 @@ class ServingEngine:
 
     def _admit(self) -> None:
         for slot in range(self.num_slots):
-            if self._active[slot] or not self._queue:
+            if self._active[slot]:
                 continue
-            req = self._queue.popleft()
-            l = int(req.prompt.size)
-            s_p = self._bucket_for(l)
-            prompt = np.zeros((1, s_p), np.int32)
-            prompt[0, s_p - l :] = req.prompt  # left-pad, right-aligned
-            self._rng, sub = jax.random.split(self._rng)
-            t0 = time.perf_counter()
-            # Queue wait is only known now — emit it retrospectively,
-            # spanning submit→admission, as the request tree's first leaf.
-            self._phase(
-                "queue_wait", t0=req.t_submit, dur_s=t0 - req.t_submit,
-                trace=req.trace, parent=req.span, slot=slot,
-            )
+            # One free slot keeps consuming the queue until a request
+            # actually admits: expired and poison requests resolve typed
+            # and must not burn the slot's admission for this step.
+            while self._queue:
+                req = self._queue.popleft()
+                if self._expired(req):
+                    # Past deadline while still queued: shedding now is
+                    # strictly better than prefilling work whose answer
+                    # the caller has already abandoned.
+                    self._m_deadline.inc()
+                    self._complete_unadmitted(req, "deadline")
+                    continue
+                if self._try_admit(slot, req):
+                    break
+
+    def _try_admit(self, slot: int, req: ServeRequest) -> bool:
+        """Prefill + graft ``req`` into ``slot``. A failure ANYWHERE in
+        the request's own admission work (poison prompt crashing the
+        prefill, cache growth failing) quarantines THIS request with a
+        typed ``"error"`` completion and leaves the engine serving — one
+        failing request must never wedge the batch (ISSUE 9). The shared
+        cache is only rebound to outputs of successful programs, so a
+        failed admission cannot corrupt live slots."""
+        l = int(req.prompt.size)
+        s_p = self._bucket_for(l)
+        prompt = np.zeros((1, s_p), np.int32)
+        prompt[0, s_p - l :] = req.prompt  # left-pad, right-aligned
+        prev_rng = self._rng
+        self._rng, sub = jax.random.split(self._rng)
+        t0 = time.perf_counter()
+        # Queue wait is only known now — emit it retrospectively,
+        # spanning submit→admission, as the request tree's first leaf.
+        self._phase(
+            "queue_wait", t0=req.t_submit, dur_s=t0 - req.t_submit,
+            trace=req.trace, parent=req.span, slot=slot,
+        )
+        try:
+            faults.maybe_raise("serve.prefill", key=req.id)
             with self._trace_ctx():
                 tok, slot_cache = self._prefill_fn(s_p)(
                     self.params,
@@ -546,31 +717,53 @@ class ServingEngine:
                     slot=slot, bucket=self.bucket,
                 )
             tok = int(jax.device_get(tok)[0])
-            dt = time.perf_counter() - t0
-            self.stats[f"prefill_{s_p}"] += 1
-            # TTFT = submit-to-first-token work this engine performed for
-            # the request: prefill + graft + the forced first-token fetch.
-            # (Queue wait is visible separately via serve_queue_depth.)
-            self._m_ttft.observe(dt)
-            self._m_prefills.inc()
-            self._m_grafts.inc()
-            self._m_bytes_slot.set(self.bytes_per_slot())
-            self._phase(
-                "prefill", t0=t0, dur_s=dt, trace=req.trace,
-                parent=req.span,
-                slot=slot, bucket=s_p, request=req.id,
+        except Exception as e:
+            # Quarantine: typed resolution + counter + a loud log with
+            # the cause — systemic breakage (every request failing) shows
+            # up immediately in serve_quarantined_total's rate. The
+            # failed admission's RNG split is rolled back, so later
+            # requests see exactly the splits a fault-free run would
+            # give them — chaos token-identity holds for SAMPLED
+            # (temperature>0) decode too, not just greedy.
+            self._rng = prev_rng
+            self._m_quarantined.inc()
+            self.stats["quarantined"] += 1
+            from frl_distributed_ml_scaffold_tpu.utils.logging import (
+                get_logger,
             )
-            self.watchdog.beat()
 
-            self._req[slot] = req
-            self._tokens[slot] = [tok]
-            self._len[slot] = l + 1
-            self._active[slot] = True
-            self._latency[slot] = [dt]
-            self._last_tok[slot] = tok
-            # The first sampled token can already finish the request.
-            if self._finishes(slot, tok):
-                continue
+            get_logger().warning(
+                "serving: request %d quarantined at admission "
+                "(%s: %s) — slot %d stays free, batch keeps decoding",
+                req.id, type(e).__name__, e, slot,
+            )
+            self._complete_unadmitted(req, "error")
+            return False
+        dt = time.perf_counter() - t0
+        self.stats[f"prefill_{s_p}"] += 1
+        # TTFT = submit-to-first-token work this engine performed for
+        # the request: prefill + graft + the forced first-token fetch.
+        # (Queue wait is visible separately via serve_queue_depth.)
+        self._m_ttft.observe(dt)
+        self._m_prefills.inc()
+        self._m_grafts.inc()
+        self._m_bytes_slot.set(self.bytes_per_slot())
+        self._phase(
+            "prefill", t0=t0, dur_s=dt, trace=req.trace,
+            parent=req.span,
+            slot=slot, bucket=s_p, request=req.id,
+        )
+        self.watchdog.beat()
+
+        self._req[slot] = req
+        self._tokens[slot] = [tok]
+        self._len[slot] = l + 1
+        self._active[slot] = True
+        self._latency[slot] = [dt]
+        self._last_tok[slot] = tok
+        # The first sampled token can already finish the request.
+        self._finishes(slot, tok)
+        return True
 
     def _finishes(self, slot: int, tok: int) -> bool:
         req = self._req[slot]
@@ -621,10 +814,15 @@ class ServingEngine:
     def step(self) -> list[Completion]:
         """Admit into free slots, run ONE decode iteration over the slot
         array, retire finished rows. Returns requests completed during
-        this step (possibly at admission, for 1-token budgets)."""
+        this step (possibly at admission, for 1-token budgets; typed
+        shed/deadline/error resolutions ride along)."""
         self._completed: list[Completion] = []
         self._m_queue.set(len(self._queue))
         self._admit()
+        # Typed completions resolved since the last step (shed at
+        # submit) and during this admission round (expired/quarantined).
+        self._completed.extend(self._early)
+        self._early.clear()
         self._m_occupancy.set(float(self._active.sum()) / self.num_slots)
         if not self._active.any():
             return self._completed
@@ -633,7 +831,31 @@ class ServingEngine:
         # active row holds cache_index == _len - 1 (prefill sets idx=l
         # with _len=l+1; both advance together), so this step writes
         # position _len - 1 and needs capacity exactly _len.
-        self._ensure_bucket(int(self._len[self._active].max()))
+        try:
+            self._ensure_bucket(int(self._len[self._active].max()))
+        except CacheGrowError as e:
+            # Degrade, don't die: rows that NEED the larger bucket are
+            # retired typed ("error", carrying their tokens so far); rows
+            # still inside the current bucket keep decoding — a capacity
+            # failure at high occupancy costs the big requests, never the
+            # whole batch.
+            from frl_distributed_ml_scaffold_tpu.utils.logging import (
+                get_logger,
+            )
+
+            victims = [
+                s for s in np.flatnonzero(self._active)
+                if self._len[s] > self.bucket
+            ]
+            get_logger().warning(
+                "serving: cache grow failed (%s); retiring %d slot(s) "
+                "needing the larger bucket, %d keep decoding",
+                e, len(victims), int(self._active.sum()) - len(victims),
+            )
+            for s in victims:
+                self._retire(int(s), "error")
+            if not self._active.any():
+                return self._completed
 
         self._rng, sub = jax.random.split(self._rng)
         t0 = time.perf_counter()
@@ -681,5 +903,14 @@ class ServingEngine:
                 parent=req.span, slot=slot,
                 token=len(self._tokens[slot]) - 1,
             )
-            self._finishes(slot, tok)
+            if self._finishes(slot, tok):
+                continue
+            # Mid-decode deadline cancellation (ISSUE 9): a natural
+            # finish (eos/budget) wins; otherwise a request past its
+            # deadline retires NOW with the tokens it has — the slot is
+            # freed for refill instead of burning decode steps on an
+            # answer the caller has stopped waiting for.
+            if self._expired(req):
+                self._m_deadline.inc()
+                self._retire(slot, "deadline")
         return self._completed

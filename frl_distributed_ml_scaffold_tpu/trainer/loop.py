@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from frl_distributed_ml_scaffold_tpu import faults
 from frl_distributed_ml_scaffold_tpu.config.schema import ExperimentConfig
 from frl_distributed_ml_scaffold_tpu.data.pipeline import build_pipeline
 from frl_distributed_ml_scaffold_tpu.dist.mesh import MeshEnv, build_mesh
@@ -465,8 +466,11 @@ class Trainer:
                 cost = cost[0] if cost else None
             if cost and float(cost.get("flops", 0.0)) > 0:
                 return dict(cost)
-        except Exception:
-            pass
+        except Exception as e:
+            self.logger.debug(
+                "XLA cost analysis unavailable (%s); trying the jaxpr "
+                "FLOPs counter", e,
+            )
         # Backends without cost analysis (the axon TPU plugin): count
         # matmul/conv FLOPs straight off the train-step jaxpr — exact for
         # fwd+bwd+optimizer, no backend needed.
@@ -679,6 +683,14 @@ class Trainer:
                     t_disp = _time.perf_counter()
                     with _span_disp(step):
                         state, metrics = self.train_step(state, batch)
+                # Fault sites (ISSUE 9, faults/plan.py): a hung step is
+                # the stall watchdog's prey (the sleep lands between
+                # beats, exactly like a wedged collective); a preempt
+                # fires our own SIGTERM so the graceful checkpoint-and-
+                # exit path below runs. Both no-op unarmed.
+                faults.maybe_hang("trainer.hung_step", key=step)
+                if faults.fire("trainer.preempt", key=step) is not None:
+                    os.kill(os.getpid(), _signal.SIGTERM)
                 if not tracer.enabled:
                     # tracing=false must not silence telemetry.jsonl's
                     # phase records — fall back to bare timeline events.
@@ -774,7 +786,14 @@ class Trainer:
                         # Skip the forced save when the periodic one just
                         # covered this step — re-serializing an identical
                         # checkpoint burns the fixed preemption grace window.
-                        if (step + 1) % cfg.checkpoint.save_every != 0:
+                        # trainer.preempt_save=false skips the forced save
+                        # entirely (externally managed checkpoints) but
+                        # still waits: in-flight periodic saves must land
+                        # their commit markers before the clean exit.
+                        if (
+                            cfg.trainer.preempt_save
+                            and (step + 1) % cfg.checkpoint.save_every != 0
+                        ):
                             self.checkpointer.save(step + 1, state, force=True)
                         self.checkpointer.wait()
                     last_record = metric_logger.log(
@@ -818,8 +837,11 @@ class Trainer:
                         tracer.write_chrome_trace(
                             os.path.join(run_dir, "trace_events.json")
                         )
-            except Exception:  # observability must not mask the real error
-                pass
+            except Exception as e:  # observability must not mask the real error
+                self.logger.warning(
+                    "final telemetry flush failed (%s: %s); continuing "
+                    "shutdown", type(e).__name__, e,
+                )
             telemetry_jsonl.close()
             if hasattr(self.pipeline, "close"):
                 self.pipeline.close()  # stop prefetch worker + in-flight work

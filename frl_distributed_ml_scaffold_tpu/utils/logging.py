@@ -49,15 +49,19 @@ def is_primary_process() -> bool:
         pid = getattr(distributed.global_state, "process_id", None)
         if pid is not None:
             return pid == 0
-    except Exception:  # private-API drift: fall through
-        pass
+    except Exception as e:  # private-API drift: fall through
+        logging.getLogger(__name__).debug(
+            "distributed-runtime process-id probe failed (%s)", e
+        )
     try:
         from jax._src import xla_bridge
 
         if getattr(xla_bridge, "_backends", None):
             return jax.process_index() == 0
-    except Exception:
-        pass
+    except Exception as e:  # private-API drift: fall through
+        logging.getLogger(__name__).debug(
+            "backend process-index probe failed (%s)", e
+        )
     return True
 
 

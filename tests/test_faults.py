@@ -1,0 +1,838 @@
+"""Chaos suite (ISSUE 9): the fault matrix, pinned.
+
+Every injected fault class must prove BOTH detection and recovery
+(docs/operations.md "Failure semantics" is the human-readable matrix
+this file enforces):
+
+- torn / corrupt checkpoint  → commit markers skip it, restore falls
+  back down the committed chain, the directory is reported, not deleted;
+- loader exception           → unified retry policy rebuilds the batch
+  (pure function of step), permanent faults still kill the run loudly;
+- hung step                  → the stall watchdog fires;
+- SIGTERM preemption         → synchronized checkpoint + clean exit +
+  exact resume;
+- serving queue overflow /
+  deadline overrun /
+  poison request /
+  cache-grow failure         → typed completions, slot freed for refill,
+  and — the acceptance headline — every NON-faulted request stays
+  token-identical to its solo ``generate()`` run under chaos;
+- heartbeat-write failures   → counted, membership record retired after
+  N consecutive so peers evict deterministically.
+
+Injection is the seeded ``FaultPlan`` (faults/plan.py): deterministic,
+no-op unarmed, telemetry-counted.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+
+import pytest as _pytest_mark
+
+pytestmark = _pytest_mark.mark.chaos
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from _jit import jit_init
+
+from frl_distributed_ml_scaffold_tpu import faults
+from frl_distributed_ml_scaffold_tpu.config import apply_overrides, get_config
+from frl_distributed_ml_scaffold_tpu.config.schema import (
+    GPTConfig,
+    PrecisionConfig,
+    ServingConfig,
+)
+from frl_distributed_ml_scaffold_tpu.faults import (
+    FaultPlan,
+    FaultSpec,
+    RetryPolicy,
+)
+from frl_distributed_ml_scaffold_tpu.models.generation import generate
+from frl_distributed_ml_scaffold_tpu.models.gpt import GPT
+from frl_distributed_ml_scaffold_tpu.precision import get_policy
+from frl_distributed_ml_scaffold_tpu.serving import ServingEngine
+from frl_distributed_ml_scaffold_tpu.telemetry import MetricsRegistry
+from frl_distributed_ml_scaffold_tpu.trainer.loop import Trainer
+
+
+# ----------------------------------------------------------- plan + retry
+
+
+@pytest.mark.fast
+def test_fault_plan_fires_on_exact_occurrence_window():
+    """at/times index MATCHING consultations 1-based and deterministically;
+    unarmed sites cost one dict lookup and never fire."""
+    plan = FaultPlan([FaultSpec(site="serve.grow", at=3, times=2)])
+    fired = [plan.fire("serve.grow") is not None for _ in range(6)]
+    assert fired == [False, False, True, True, False, False]
+    assert plan.injected == {"serve.grow": 2}
+    assert plan.fire("checkpoint.torn_write") is None  # unarmed site
+    # times=0: every consultation from `at` on.
+    forever = FaultPlan([dict(site="data.loader", at=2, times=0)])
+    assert [forever.fire("data.loader") is not None for _ in range(4)] == [
+        False, True, True, True,
+    ]
+    # Two specs stacked on ONE site count consultations independently:
+    # at=1 and at=2 fire on consultations 1 and 2 (an early return after
+    # the first spec would make the second window fire late).
+    stacked = FaultPlan(
+        [dict(site="serve.grow", at=1), dict(site="serve.grow", at=2)]
+    )
+    assert [stacked.fire("serve.grow") is not None for _ in range(3)] == [
+        True, True, False,
+    ]
+    assert stacked.injected == {"serve.grow": 2}
+
+
+@pytest.mark.fast
+def test_fault_plan_keyed_matching_and_seeded_probability():
+    """A keyed spec counts only matching consultations; p<1 draws ride
+    the plan's seed, so the same seed replays the same chaos."""
+    plan = FaultPlan([dict(site="serve.prefill", key="7", at=2)])
+    seq = [
+        plan.fire("serve.prefill", k) is not None
+        for k in ("5", "7", "5", "7", "7")
+    ]
+    # Consultations with key "7" are #1, #2, #3 of the spec: fires on #2.
+    assert seq == [False, False, False, True, False]
+
+    def draws(seed):
+        p = FaultPlan([dict(site="data.loader", times=0, p=0.5)], seed=seed)
+        return [p.fire("data.loader") is not None for _ in range(32)]
+
+    assert draws(3) == draws(3)
+    assert draws(3) != draws(4)  # astronomically unlikely to collide
+    assert 0 < sum(draws(3)) < 32
+
+
+@pytest.mark.fast
+def test_fault_plan_env_roundtrip_and_refusals():
+    plan = FaultPlan.from_env(
+        '{"seed": 5, "specs": [{"site": "trainer.hung_step", "arg": 0.25}]}'
+    )
+    assert plan.seed == 5 and plan.sites == ["trainer.hung_step"]
+    assert FaultPlan.from_env('[{"site": "serve.grow"}]').sites == ["serve.grow"]
+    with pytest.raises(ValueError, match="not valid JSON"):
+        FaultPlan.from_env("serve.grow@3")
+    with pytest.raises(ValueError, match="unknown fault site"):
+        FaultPlan([dict(site="serve.typo")])
+    with pytest.raises(ValueError, match="at="):
+        FaultPlan([dict(site="serve.grow", at=0)])
+
+
+@pytest.mark.fast
+def test_fault_plan_counts_injections_in_telemetry():
+    reg = MetricsRegistry()
+    plan = FaultPlan(
+        [dict(site="serve.grow", times=2)], registry=reg
+    )
+    # Armed-site counters exist at 0 before any firing (catalog contract).
+    assert reg.counter("fault_injected_serve_grow_total").value == 0
+    for _ in range(5):
+        plan.fire("serve.grow")
+    assert reg.counter("fault_injected_total").value == 2
+    assert reg.counter("fault_injected_serve_grow_total").value == 2
+
+
+@pytest.mark.fast
+def test_ambient_plan_scoping():
+    assert faults.fire("serve.grow") is None
+    with faults.active(FaultPlan([dict(site="serve.grow", times=0)])) as p:
+        assert faults.fire("serve.grow") is p._by_site["serve.grow"][0]
+        with pytest.raises(OSError):
+            faults.maybe_raise("serve.grow", OSError)
+    assert faults.fire("serve.grow") is None  # restored on exit
+
+
+@pytest.mark.fast
+def test_retry_policy_delays_and_budget():
+    rp = RetryPolicy(max_retries=4, backoff_s=0.5, max_backoff_s=3.0)
+    assert [rp.delay(i) for i in (1, 2, 3, 4)] == [0.5, 1.0, 2.0, 3.0]
+    jit = RetryPolicy(max_retries=6, backoff_s=1.0, jitter=0.5, seed=9)
+    a, b = list(jit.delays()), list(jit.delays())
+    assert a == b  # seeded jitter replays
+    assert all(0.0 < d for d in a) and any(d != jit.delay(i + 1) or True for i, d in enumerate(a))
+    with pytest.raises(ValueError):
+        RetryPolicy(jitter=1.5)
+
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    slept: list[float] = []
+    assert rp.call(flaky, sleep=slept.append) == "ok"
+    assert calls["n"] == 3 and slept == [0.5, 1.0]
+
+    def always():
+        raise OSError("permanent")
+
+    counter = MetricsRegistry().counter("retries")
+    with pytest.raises(OSError, match="permanent"):
+        rp.call(always, sleep=lambda d: None, counter=counter)
+    # Only PERFORMED retries count — the budget-exhausting failure
+    # propagates, it is not a retry (no phantom attempt in the ledger).
+    assert counter.value == rp.max_retries
+
+    # Exceptions outside retry_on propagate immediately (no absorption).
+    def wrong():
+        raise KeyError("bug")
+
+    with pytest.raises(KeyError):
+        rp.call(wrong, retry_on=(OSError,), sleep=lambda d: None)
+
+
+# ----------------------------------------------------------------- serving
+
+
+FP32 = get_policy(PrecisionConfig(policy="fp32"))
+TINY = dict(
+    vocab_size=64, num_layers=2, num_heads=4, hidden_dim=64, seq_len=64,
+    dropout=0.0,
+)
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    model = GPT(GPTConfig(**TINY), FP32)
+    tokens = jax.random.randint(jax.random.key(1), (2, 8), 0, 64)
+    params = jit_init(model, tokens, train=False)["params"]
+    return model, params
+
+
+def _solo(model, params, prompt, n_new):
+    ref = generate(
+        model, params, jnp.asarray(prompt)[None], max_new_tokens=n_new,
+        temperature=0.0,
+    )
+    return np.asarray(ref)[0]
+
+
+@pytest.mark.fast
+@pytest.mark.serving
+def test_queue_overflow_sheds_typed_completions(gpt):
+    """Submits beyond max_queue_depth resolve IMMEDIATELY as typed
+    "shed" completions (prompt back, zero tokens), counted, while
+    admitted requests serve normally — run() never hangs on a shed id."""
+    model, params = gpt
+    eng = ServingEngine(
+        model, params, num_slots=1, temperature=0.0, max_queue_depth=2
+    )
+    rids = [eng.submit(np.arange(4, dtype=np.int32) + i, 3) for i in range(5)]
+    done = {c.id: c for c in eng.run()}
+    assert sorted(done) == sorted(rids), "an id never resolved"
+    reasons = [done[r].finish_reason for r in rids]
+    # No step ran between submits, so the queue only fills: r0, r1 make
+    # depth 2 and every later submit sheds — exactly 3 typed sheds.
+    assert reasons == ["length", "length", "shed", "shed", "shed"]
+    for rid in rids[:2]:
+        assert done[rid].ok
+        np.testing.assert_array_equal(
+            done[rid].tokens,
+            _solo(model, params, np.asarray(done[rid].tokens[:done[rid].prompt_len]), 3),
+        )
+    for rid in rids[3:]:
+        c = done[rid]
+        assert c.finish_reason == "shed" and len(c.tokens) == c.prompt_len
+        assert c.token_latencies_s == []
+    assert eng.telemetry.counter("serve_shed_total").value == 3
+    assert eng.stats["finish_shed"] == 3
+    eng.close()
+
+
+@pytest.mark.fast
+@pytest.mark.serving
+def test_deadline_expired_in_queue_sheds_before_prefill(gpt):
+    """A request whose deadline passes while QUEUED is shed at admission
+    (no prefill work wasted on an abandoned answer) with a typed
+    "deadline" completion; the slot admits the next request instead."""
+    model, params = gpt
+    eng = ServingEngine(model, params, num_slots=1, temperature=0.0)
+    ra = eng.submit(np.arange(5, dtype=np.int32), 6, deadline_s=1e-6)
+    rb = eng.submit(np.arange(5, dtype=np.int32) + 2, 3)
+    time.sleep(0.01)  # let ra's deadline lapse before any admission
+    done = {c.id: c for c in eng.run()}
+    assert done[ra].finish_reason == "deadline"
+    assert len(done[ra].tokens) == done[ra].prompt_len  # nothing generated
+    assert done[rb].ok
+    np.testing.assert_array_equal(
+        done[rb].tokens, _solo(model, params, np.arange(5, dtype=np.int32) + 2, 3)
+    )
+    assert eng.telemetry.counter("serve_deadline_miss_total").value == 1
+    assert eng.stats["prefill_8"] == 1  # only rb was prefilled
+    eng.close()
+
+
+@pytest.mark.fast
+@pytest.mark.serving
+def test_deadline_mid_decode_cancels_and_frees_slot(gpt):
+    """Mid-decode cancellation: an in-flight request past its deadline
+    retires with the tokens generated SO FAR (typed "deadline"), the
+    slot refills, and the refilled request completes token-identically."""
+    model, params = gpt
+    eng = ServingEngine(model, params, num_slots=1, temperature=0.0)
+    ra = eng.submit(np.arange(5, dtype=np.int32), 30, deadline_s=60.0)
+    rb = eng.submit(np.arange(6, dtype=np.int32), 3)
+    first = eng.step()  # prefill + first decode tick for ra
+    assert not first and eng._active[0]
+    # Deterministic expiry: collapse ra's deadline after real decode work
+    # has happened (wall-clock thresholds would flake on a loaded box).
+    eng._req[0].deadline_s = 1e-6
+    done = {c.id: c for c in first + eng.run()}
+    assert done[ra].finish_reason == "deadline"
+    n_partial = len(done[ra].tokens) - done[ra].prompt_len
+    assert n_partial >= 1, "cancellation should carry the partial answer"
+    assert len(done[ra].token_latencies_s) == n_partial
+    # The freed slot served rb to completion, token-identical.
+    assert done[rb].ok
+    np.testing.assert_array_equal(
+        done[rb].tokens, _solo(model, params, np.arange(6, dtype=np.int32), 3)
+    )
+    assert eng.telemetry.counter("serve_deadline_miss_total").value == 1
+    eng.close()
+
+
+@pytest.mark.fast
+@pytest.mark.serving
+def test_poison_request_quarantined_batch_survives(gpt):
+    """One failing request cannot wedge the batch: the poisoned prefill
+    yields a typed "error" completion + quarantine counter, concurrent
+    requests stay token-identical, and the engine keeps admitting new
+    work afterwards."""
+    model, params = gpt
+    eng = ServingEngine(model, params, num_slots=2, temperature=0.0)
+    ra = eng.submit(np.arange(5, dtype=np.int32), 4)
+    rb = eng.submit(np.arange(6, dtype=np.int32), 4)
+    with faults.active(FaultPlan([dict(site="serve.prefill", key=str(ra))])):
+        done = {c.id: c for c in eng.run()}
+    assert done[ra].finish_reason == "error"
+    assert done[rb].ok
+    np.testing.assert_array_equal(
+        done[rb].tokens, _solo(model, params, np.arange(6, dtype=np.int32), 4)
+    )
+    assert eng.telemetry.counter("serve_quarantined_total").value == 1
+    # Plan disarmed: the same prompt now serves fine (nothing latched).
+    rc = eng.submit(np.arange(5, dtype=np.int32), 4)
+    done2 = {c.id: c for c in eng.run()}
+    assert done2[rc].ok
+    np.testing.assert_array_equal(
+        done2[rc].tokens, _solo(model, params, np.arange(5, dtype=np.int32), 4)
+    )
+    eng.close()
+
+
+@pytest.mark.fast
+@pytest.mark.serving
+def test_quarantine_is_rng_neutral_for_sampled_decode(gpt):
+    """A quarantined admission rolls the engine RNG back, so chaos
+    token-identity holds for SAMPLED (temperature>0) decode too: the
+    healthy request sees exactly the splits a fault-free engine would
+    have handed it, poison or no poison."""
+    model, params = gpt
+    prompt = np.arange(5, dtype=np.int32)
+
+    ref_eng = ServingEngine(model, params, num_slots=2, temperature=0.7)
+    rid = ref_eng.submit(prompt, 6)
+    ref = {c.id: c for c in ref_eng.run()}[rid].tokens
+    ref_eng.close()
+
+    eng = ServingEngine(model, params, num_slots=2, temperature=0.7)
+    pid = eng.submit(np.arange(3, dtype=np.int32), 4)  # poisoned first
+    hid = eng.submit(prompt, 6)
+    with faults.active(
+        FaultPlan([dict(site="serve.prefill", key=str(pid), times=0)])
+    ):
+        done = {c.id: c for c in eng.run()}
+    assert done[pid].finish_reason == "error"
+    np.testing.assert_array_equal(done[hid].tokens, ref)
+    eng.close()
+
+
+@pytest.mark.fast
+@pytest.mark.serving
+def test_grow_failure_degrades_not_dies(gpt):
+    """A cache-grow allocation failure retires only the rows that NEED
+    the larger bucket (typed "error", partial tokens carried); rows
+    inside the current bucket — INCLUDING one sitting exactly at
+    ``_len == bucket``, which needs capacity exactly ``_len`` and so
+    still fits — finish token-identically and the engine grows fine once
+    the fault clears."""
+    model, params = gpt
+    eng = ServingEngine(
+        model, params, num_slots=2, temperature=0.0, min_bucket=8
+    )
+    ra = eng.submit(np.arange(4, dtype=np.int32), 30)  # needs bucket 16+
+    # Admitted the same step as ra (prompt 3 -> _len 4 after prefill), so
+    # when ra forces the grow (its _len hits 9) rb sits at _len == 8: the
+    # bucket-boundary row the victim cut must NOT retire.
+    rb = eng.submit(np.arange(3, dtype=np.int32) + 1, 10)
+    with faults.active(FaultPlan([dict(site="serve.grow")])):  # fires once
+        done = {c.id: c for c in eng.run()}
+    assert done[ra].finish_reason == "error"
+    assert len(done[ra].tokens) > done[ra].prompt_len  # partial answer
+    # rb survived the failed grow at the boundary, then grew for real
+    # once the one-shot fault was exhausted (its own _len passes 8).
+    assert done[rb].ok
+    np.testing.assert_array_equal(
+        done[rb].tokens,
+        _solo(model, params, np.arange(3, dtype=np.int32) + 1, 10),
+    )
+    assert eng.telemetry.counter("serve_grow_failures_total").value >= 1
+    assert eng.stats["grow_failures"] >= 1
+    # Fault cleared: the same big request now grows and completes.
+    rc = eng.submit(np.arange(4, dtype=np.int32), 30)
+    done2 = {c.id: c for c in eng.run()}
+    assert done2[rc].ok
+    np.testing.assert_array_equal(
+        done2[rc].tokens, _solo(model, params, np.arange(4, dtype=np.int32), 30)
+    )
+    eng.close()
+
+
+@pytest.mark.serving
+def test_chaos_non_faulted_requests_token_identical(gpt):
+    """The acceptance headline: queue bound + deadlines + poison at once,
+    and every NON-faulted request still equals its solo generate() run
+    token-for-token, while every faulted one gets a typed completion.
+    ServingConfig knobs drive the engine the way a production config
+    would."""
+    model, params = gpt
+    scfg = ServingConfig(max_queue_depth=4, default_deadline_s=0.0)
+    eng = ServingEngine(
+        model, params, num_slots=2, temperature=0.0, serving=scfg,
+    )
+    rng = np.random.default_rng(0)
+    reqs = {}
+    poison_rid = 1  # ids are sequential on a fresh engine
+    with faults.active(
+        FaultPlan([dict(site="serve.prefill", key=str(poison_rid), times=0)])
+    ):
+        for i in range(6):
+            prompt = rng.integers(0, 64, size=int(rng.integers(2, 10))).astype(
+                np.int32
+            )
+            n_new = int(rng.integers(2, 6))
+            dl = 1e-6 if i == 2 else 0.0  # request 2: instant deadline
+            rid = eng.submit(prompt, n_new, deadline_s=dl)
+            reqs[rid] = (prompt, n_new)
+        done = {c.id: c for c in eng.run()}
+    assert sorted(done) == sorted(reqs), "every id resolves exactly once"
+    reasons = {rid: done[rid].finish_reason for rid in sorted(done)}
+    assert reasons[poison_rid] == "error"
+    assert reasons[2] == "deadline"
+    assert list(reasons.values()).count("shed") == 2  # submits 4, 5 overflowed
+    ok = [rid for rid, c in done.items() if c.ok]
+    assert ok, reasons
+    for rid in ok:
+        prompt, n_new = reqs[rid]
+        np.testing.assert_array_equal(
+            done[rid].tokens, _solo(model, params, prompt, n_new),
+            err_msg=f"request {rid} diverged under chaos",
+        )
+    t = eng.telemetry
+    assert t.counter("serve_shed_total").value == 2
+    assert t.counter("serve_quarantined_total").value == 1
+    assert t.counter("serve_deadline_miss_total").value == 1
+    eng.close()
+
+
+# -------------------------------------------------------------- checkpoint
+
+
+def _trainer_cfg(tmp_path, extra=()):
+    return apply_overrides(
+        get_config("mnist_mlp"),
+        [
+            "trainer.total_steps=6",
+            "trainer.log_every=3",
+            "trainer.eval_every=0",
+            "data.global_batch_size=64",
+            "model.hidden_sizes=32",
+            "precision.policy=fp32",
+            f"workdir={tmp_path}",
+        ]
+        + list(extra),
+    )
+
+
+CKPT = [
+    "checkpoint.enabled=true",
+    "checkpoint.save_every=2",
+    "checkpoint.async_save=false",
+]
+
+
+def test_torn_checkpoint_write_skipped_and_resumed_from_last_good(tmp_path):
+    """Satellite 3 + tentpole (c): a torn write at step 6 (third save) is
+    invisible to latest_step(), restore_or_init resumes from step 4 (last
+    committed), training completes, and the torn directory is REPORTED
+    and left on disk."""
+    cfg = _trainer_cfg(tmp_path, CKPT)
+    with faults.active(FaultPlan([dict(site="checkpoint.torn_write", at=3)])):
+        t = Trainer(cfg)
+        t.fit()
+        t.checkpointer.close()
+
+    fresh = Trainer(cfg)
+    ck = fresh.checkpointer
+    assert ck.all_steps(include_uncommitted=True) == [2, 4, 6]
+    assert ck.all_steps() == [2, 4]
+    assert ck.latest_step() == 4
+    assert ck.uncommitted_steps() == [6]
+    # The torn directory is reported, never silently deleted.
+    assert os.path.isdir(os.path.join(str(tmp_path), cfg.name, "ckpt", "6"))
+
+    restored = ck.restore_or_init(fresh)
+    assert int(jax.device_get(restored.step)) == 4
+    state, _ = fresh.fit(restored)
+    assert int(jax.device_get(state.step)) == 6
+    fresh.checkpointer.close()
+
+
+def test_corrupt_committed_step_falls_back_down_chain(tmp_path):
+    """Bit rot a marker cannot see: a COMMITTED step whose payload is
+    truncated fails restore, is recorded in corrupt_steps (dir kept), and
+    restore_or_init lands on the previous committed step."""
+    import glob
+
+    cfg = _trainer_cfg(tmp_path, CKPT)
+    t = Trainer(cfg)
+    t.fit()
+    t.checkpointer.close()
+
+    files = [
+        p
+        for p in glob.glob(
+            os.path.join(str(tmp_path), cfg.name, "ckpt", "6", "**", "*"),
+            recursive=True,
+        )
+        if os.path.isfile(p)
+    ]
+    victim = max(files, key=os.path.getsize)
+    with open(victim, "r+b") as fh:
+        fh.truncate(3)
+
+    fresh = Trainer(cfg)
+    restored = fresh.checkpointer.restore_or_init(fresh)
+    assert int(jax.device_get(restored.step)) == 4
+    assert fresh.checkpointer.corrupt_steps == [6]
+    assert os.path.isdir(os.path.join(str(tmp_path), cfg.name, "ckpt", "6"))
+    fresh.checkpointer.close()
+
+
+def test_legacy_checkpoint_dir_without_markers_still_restores(tmp_path):
+    """Directories written before the commit-marker protocol (no
+    commits/ dir) are honored wholesale — the marker protocol must not
+    orphan existing checkpoints."""
+    cfg = _trainer_cfg(tmp_path, CKPT)
+    t = Trainer(cfg)
+    t.fit()
+    t.checkpointer.close()
+    shutil.rmtree(os.path.join(str(tmp_path), cfg.name, "ckpt", "commits"))
+
+    fresh = Trainer(cfg)
+    assert fresh.checkpointer.latest_step() == 6
+    assert fresh.checkpointer.uncommitted_steps() == []
+    restored = fresh.checkpointer.restore_or_init(fresh)
+    assert int(jax.device_get(restored.step)) == 6
+    fresh.checkpointer.close()
+
+
+def test_first_commit_backfills_legacy_markers(tmp_path):
+    """The FIRST new-protocol save in a pre-marker directory backfills
+    markers for the legacy steps atomically — they were committed
+    wholesale and must STAY committed once commits/ exists (otherwise
+    one new save would flip the entire pre-existing history to
+    "uncommitted" and a crash mid-transition could orphan it)."""
+    cfg = _trainer_cfg(tmp_path, CKPT)
+    t = Trainer(cfg)
+    t.fit()
+    t.checkpointer.close()
+    shutil.rmtree(os.path.join(str(tmp_path), cfg.name, "ckpt", "commits"))
+
+    cfg2 = _trainer_cfg(tmp_path, CKPT + ["trainer.total_steps=8"])
+    fresh = Trainer(cfg2)
+    restored = fresh.checkpointer.restore_or_init(fresh)
+    assert int(jax.device_get(restored.step)) == 6  # wholesale honor
+    fresh.fit(restored)  # saves step 8 -> first _commit backfills
+    fresh.checkpointer.close()
+
+    ck = Trainer(cfg2).checkpointer
+    # max_to_keep=3 garbage-collected step 2 when 8 landed; the legacy
+    # steps that remain on disk (4, 6) stayed committed through the
+    # transition instead of flipping to "uncommitted".
+    assert ck.all_steps() == [4, 6, 8]
+    assert ck.uncommitted_steps() == []
+    assert ck.latest_step() == 8
+    ck.close()
+
+
+def test_async_saves_commit_at_wait(tmp_path):
+    """Async saves stay uncommitted until wait()/close() proves the bytes
+    (fit() waits in its final block, so a normal run commits everything)."""
+    cfg = _trainer_cfg(
+        tmp_path,
+        ["checkpoint.enabled=true", "checkpoint.save_every=2",
+         "checkpoint.async_save=true"],
+    )
+    t = Trainer(cfg)
+    t.fit()
+    t.checkpointer.close()
+    fresh = Trainer(cfg)
+    assert fresh.checkpointer.latest_step() == 6
+    assert fresh.checkpointer.uncommitted_steps() == []
+    fresh.checkpointer.close()
+
+
+# ----------------------------------------------------------------- trainer
+
+
+def test_loader_fault_retried_and_run_completes(tmp_path):
+    """A transient loader exception is retried under the unified policy
+    (the batch is a pure function of step — the rebuild is exact) and
+    the run completes; retries are observable."""
+    cfg = _trainer_cfg(tmp_path)
+    with faults.active(FaultPlan([dict(site="data.loader", key="2")])):
+        t = Trainer(cfg)
+        state, _ = t.fit()
+    assert int(jax.device_get(state.step)) == 6
+    assert t.pipeline.loader_retries >= 1
+
+
+def test_loader_permanent_fault_raises_after_budget(tmp_path):
+    """A permanently failing loader exhausts the budget and propagates —
+    loud death, not an infinite retry spin."""
+    cfg = _trainer_cfg(tmp_path, ["data.loader_retry_backoff_s=0.001"])
+    with faults.active(FaultPlan([dict(site="data.loader", key="2", times=0)])):
+        t = Trainer(cfg)
+        with pytest.raises(RuntimeError, match="injected fault: data.loader"):
+            t.fit()
+
+
+@pytest.mark.obs
+def test_hung_step_fires_stall_watchdog(tmp_path):
+    """A hung step (injected 0.5 s silence against a 0.06 s deadline) is
+    DETECTED: stalls_total fires and the dump lands, while the run still
+    completes once the hang clears (recovery = the loop was only slow,
+    not dead — the watchdog's job is the report)."""
+    cfg = _trainer_cfg(
+        tmp_path,
+        ["trainer.stall_timeout_s=0.06",
+         "trainer.stall_timeout_first_beat_scale=200"],
+    )
+    with faults.active(
+        FaultPlan([dict(site="trainer.hung_step", key="3", arg=0.5)])
+    ):
+        t = Trainer(cfg)
+        state, _ = t.fit()
+    assert int(jax.device_get(state.step)) == 6
+    run_dir = os.path.join(str(tmp_path), cfg.name)
+    prom = open(os.path.join(run_dir, "metrics.prom")).read()
+    stalls = [
+        l for l in prom.splitlines()
+        if l.startswith("stalls_total ")
+    ]
+    assert stalls and float(stalls[0].split()[-1]) >= 1, prom
+    assert os.path.exists(os.path.join(run_dir, "stall_dump.txt"))
+
+
+def test_preempt_fault_checkpoints_and_resumes_exactly(tmp_path):
+    """The trainer.preempt site delivers our own SIGTERM: the in-flight
+    step finishes, a synchronized checkpoint lands (the elastic
+    supervisor reads the clean rc 0 as completion — the budget-free
+    path), and a fresh run resumes with no step lost or duplicated."""
+    cfg = _trainer_cfg(
+        tmp_path,
+        ["trainer.total_steps=10", "trainer.log_every=2",
+         "checkpoint.enabled=true", "checkpoint.save_every=100",
+         "checkpoint.async_save=false"],
+    )
+    with faults.active(FaultPlan([dict(site="trainer.preempt", key="4")])):
+        t = Trainer(cfg)
+        state, last = t.fit()
+    assert last.get("event") == "preempted"
+    assert int(jax.device_get(state.step)) == 5
+    assert t.checkpointer.latest_step() == 5
+    t.checkpointer.close()
+
+    resumed = Trainer(cfg)
+    state2, _ = resumed.fit()
+    assert int(jax.device_get(state2.step)) == 10
+    with open(os.path.join(str(tmp_path), cfg.name, "metrics.jsonl")) as fh:
+        steps = [json.loads(l)["step"] for l in fh]
+    assert steps == [2, 4, 5, 6, 8, 10], steps
+    resumed.checkpointer.close()
+
+
+def test_preempt_save_knob_off_skips_forced_save(tmp_path):
+    """trainer.preempt_save=false: the preemption still exits cleanly
+    (finish step, clean return) but writes no forced checkpoint — the
+    externally-managed-checkpoints escape hatch."""
+    cfg = _trainer_cfg(
+        tmp_path,
+        ["trainer.total_steps=10", "trainer.preempt_save=false",
+         "checkpoint.enabled=true", "checkpoint.save_every=100",
+         "checkpoint.async_save=false"],
+    )
+    with faults.active(FaultPlan([dict(site="trainer.preempt", key="4")])):
+        t = Trainer(cfg)
+        state, last = t.fit()
+    assert last.get("event") == "preempted"
+    assert int(jax.device_get(state.step)) == 5
+    assert t.checkpointer.latest_step() is None  # nothing ever saved
+    t.checkpointer.close()
+
+
+# ----------------------------------------------------------------- elastic
+
+
+@pytest.mark.fast
+def test_heartbeat_failures_counted_then_record_retired(tmp_path):
+    """Satellite 1: heartbeat-write failures are counted
+    (heartbeat_write_failures_total) and after N consecutive failures the
+    membership record is RETIRED (unlinked, thread stopped) so peers
+    evict deterministically instead of racing the staleness window."""
+    from frl_distributed_ml_scaffold_tpu.launcher.elastic import _Membership
+
+    reg = MetricsRegistry()
+    m = _Membership(str(tmp_path), uid=1, endpoint="h:1", registry=reg)
+    # First beat succeeds (the record exists), then the FS "dies".
+    with faults.active(
+        FaultPlan([dict(site="elastic.heartbeat_write", at=2, times=0)])
+    ):
+        m.start(interval_s=0.02, retire_after=3)
+        assert os.path.exists(m.path)
+        deadline = time.monotonic() + 5
+        while m._thread.is_alive() and time.monotonic() < deadline:
+            time.sleep(0.02)
+    assert not m._thread.is_alive(), "thread should have self-retired"
+    assert not os.path.exists(m.path), "record should be unlinked"
+    assert reg.counter("heartbeat_write_failures_total").value >= 3
+    m.stop()
+
+
+@pytest.mark.fast
+def test_heartbeat_transient_failures_recover_without_retirement(tmp_path):
+    """Consecutive-failure accounting resets on success: a 2-failure blip
+    under retire_after=3 keeps the membership record alive."""
+    from frl_distributed_ml_scaffold_tpu.launcher.elastic import _Membership
+
+    reg = MetricsRegistry()
+    m = _Membership(str(tmp_path), uid=2, endpoint="h:2", registry=reg)
+    with faults.active(
+        FaultPlan([dict(site="elastic.heartbeat_write", at=2, times=2)])
+    ):
+        m.start(interval_s=0.02, retire_after=3)
+        deadline = time.monotonic() + 2
+        while (
+            reg.counter("heartbeat_write_failures_total").value < 2
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.02)
+        time.sleep(0.1)  # several healthy beats after the blip
+    assert m._thread.is_alive(), "a 2-failure blip must not retire"
+    assert os.path.exists(m.path)
+    assert reg.counter("heartbeat_write_failures_total").value == 2
+    m.stop()
+
+
+def test_sigterm_fault_under_supervision_exits_clean(tmp_path):
+    """FRL_FAULT_SIGNAL=TERM: the supervised child preempts itself
+    gracefully at the fault step — checkpoint, rc 0 — and the supervisor
+    reads the clean exit as completion (the budget-free preemption
+    path), with the checkpoint ready for the next scheduled launch."""
+    from frl_distributed_ml_scaffold_tpu.launcher.elastic import supervise
+    from frl_distributed_ml_scaffold_tpu.launcher.launch import _parse_args
+
+    overrides = [
+        "trainer.total_steps=12",
+        "trainer.log_every=4",
+        "trainer.eval_every=0",
+        "data.global_batch_size=64",
+        "model.hidden_sizes=32",
+        "precision.policy=fp32",
+        "checkpoint.save_every=100",
+        "checkpoint.async_save=false",
+        "elastic.backoff_s=0.1",
+        f"workdir={tmp_path}",
+    ]
+    args = _parse_args(
+        ["--config", "mnist_mlp", "--device", "cpu", "--sim-devices", "8",
+         "--elastic"] + overrides
+    )
+    cfg = apply_overrides(get_config("mnist_mlp"), overrides)
+    os.environ["FRL_FAULT_AT_STEP"] = "5"
+    os.environ["FRL_FAULT_SIGNAL"] = "TERM"
+    try:
+        rc = supervise(args, cfg)
+    finally:
+        del os.environ["FRL_FAULT_AT_STEP"]
+        del os.environ["FRL_FAULT_SIGNAL"]
+    assert rc == 0
+    run_dir = os.path.join(str(tmp_path), cfg.name)
+    assert os.path.exists(os.path.join(run_dir, "fault_injected"))
+    with open(os.path.join(run_dir, "metrics.jsonl")) as fh:
+        recs = [json.loads(l) for l in fh]
+    # The child preempted at step 5 (graceful path logs the event)...
+    assert any(r.get("event") == "preempted" and r["step"] == 5 for r in recs)
+    # ...and the synchronized checkpoint is committed at that step.
+    from frl_distributed_ml_scaffold_tpu.checkpoint.manager import Checkpointer
+
+    ck = Checkpointer(os.path.join(run_dir, "ckpt"), cfg.checkpoint)
+    assert ck.latest_step() == 5
+    ck.close()
+
+
+# -------------------------------------------------------------- serve_bench
+
+
+@pytest.mark.serving
+def test_serve_bench_chaos_arm_reports_rates(capsys):
+    """Satellite 5: the --chaos arm reports shed rate, deadline-miss
+    rate, quarantine count, and non-faulted p99 — and the base row's
+    measured pass is unaffected (completed == requests)."""
+    import sys as _sys
+
+    tools = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"
+    )
+    if tools not in _sys.path:
+        _sys.path.insert(0, tools)
+    import serve_bench
+
+    rc = serve_bench.main(
+        [
+            "--preset", "tiny", "--requests", "6", "--slots", "2",
+            "--max-new", "4", "--sim-devices", "0",
+            "--arms", "dense_replicated", "--chaos",
+        ]
+    )
+    assert rc == 0
+    lines = [
+        l for l in capsys.readouterr().out.splitlines() if l.startswith("{")
+    ]
+    assert len(lines) == 1
+    s = json.loads(lines[0])["serving"]
+    assert s["engine_stats"]["completed"] == 6  # measured pass untouched
+    c = s["chaos"]
+    assert c["requests"] == 6
+    assert c["shed_rate"] > 0 and c["deadline_miss_rate"] > 0
+    assert c["quarantined"] == 1 and c["injected"] == {"serve.prefill": 1}
+    assert c["completed_ok"] >= 1 and c["nonfaulted_p99_ms"] > 0
+    total = (
+        c["by_reason"].get("shed", 0)
+        + c["by_reason"].get("deadline", 0)
+        + c["by_reason"].get("error", 0)
+        + c["completed_ok"]
+    )
+    assert total == c["requests"], c  # every request resolved, typed

@@ -90,7 +90,8 @@ def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument(
         "--all-recipes", action="store_true",
-        help="lint every registered recipe (plus serving + hygiene)",
+        help="lint every registered recipe (plus serving + hygiene + "
+        "robustness)",
     )
     ap.add_argument(
         "--recipe", action="append", default=[],
@@ -103,6 +104,10 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument(
         "--no-hygiene", action="store_true",
         help="skip the AST hygiene lint",
+    )
+    ap.add_argument(
+        "--no-robustness", action="store_true",
+        help="skip the failure-semantics robustness lint",
     )
     ap.add_argument(
         "--budget-mb", type=float, default=None,
@@ -145,6 +150,7 @@ def main(argv: list[str] | None = None) -> int:
         recipes=None if args.all_recipes else args.recipe,
         serving=not args.no_serving,
         hygiene=not args.no_hygiene,
+        robustness=not args.no_robustness,
         workdir=args.workdir,
         budget_bytes=budget,
         on_report=progress if args.against is None else None,

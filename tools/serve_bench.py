@@ -56,6 +56,12 @@ def _parse_args(argv=None):
                    "max-concurrent-slots column")
     p.add_argument("--out", default=None,
                    help="append emitted rows to this jsonl file")
+    p.add_argument("--chaos", action="store_true",
+                   help="after the measured pass, serve the workload "
+                   "again under injected faults (bounded queue, tiny "
+                   "deadlines on every 3rd request, one poison prefill) "
+                   "and report shed rate, deadline-miss rate, and "
+                   "non-faulted-request p99 in serving.chaos")
     return p.parse_args(argv)
 
 
@@ -158,6 +164,74 @@ def _decode_flops_per_token(model, params, num_slots: int) -> int:
     return fn_flops(step, params, cache, tok) // num_slots
 
 
+def _chaos_pass(model, run_params, args, work) -> dict:
+    """Serve the workload again under injected faults (ISSUE 9): a
+    bounded admission queue (2x slots) sheds the submit burst's tail, a
+    microscopic deadline on every 3rd request forces typed deadline
+    misses, and the second request's prefill is poisoned via the
+    ``serve.prefill`` fault site. Reports the degradation headline: shed
+    rate, deadline-miss rate, quarantine count, and the p50/p99 token
+    latency of the NON-faulted requests — the number that proves chaos
+    does not bleed into healthy traffic (tests/test_faults.py pins the
+    stronger token-identity form)."""
+    import numpy as np
+
+    from frl_distributed_ml_scaffold_tpu import faults
+    from frl_distributed_ml_scaffold_tpu.config.schema import ServingConfig
+    from frl_distributed_ml_scaffold_tpu.faults import FaultPlan
+    from frl_distributed_ml_scaffold_tpu.serving import ServingEngine
+
+    eng = ServingEngine(
+        model, run_params, num_slots=args.slots, temperature=0.0,
+        serving=ServingConfig(max_queue_depth=max(2, args.slots * 2)),
+    )
+    # Warm-up discipline (the measured-pass contract everywhere in this
+    # tool): compile every shape the chaos pass will hit, then reset, so
+    # nonfaulted_p99 measures serving under chaos — not XLA. The warm
+    # pass must submit INSIDE the queue bound (no faults armed yet).
+    for prompt, n_new in work:
+        eng.submit(prompt, n_new)
+        eng.run()
+    eng.reset_cache()
+    # The warm pass consumed ids 0..n-1: the chaos pass's ids continue at
+    # n, so the poison key targets its SECOND request (id n+1) — inside
+    # the queue bound, failing at prefill.
+    plan = FaultPlan(
+        [dict(site="serve.prefill", key=str(len(work) + 1), times=0)],
+        seed=args.seed,
+    )
+    with faults.active(plan):
+        for i, (prompt, n_new) in enumerate(work):
+            eng.submit(
+                prompt, n_new, deadline_s=1e-4 if i % 3 == 2 else 0.0
+            )
+        done = eng.run()
+    eng.close()
+    assert len(done) == len(work), (len(done), len(work))
+    n = len(done)
+    by_reason: dict[str, int] = {}
+    for c in done:
+        by_reason[c.finish_reason] = by_reason.get(c.finish_reason, 0) + 1
+    ok = [c for c in done if c.ok]
+    lat = [dt for c in ok for dt in c.token_latencies_s]
+    return {
+        "requests": n,
+        "max_queue_depth": eng.max_queue_depth,
+        "injected": dict(plan.injected),
+        "by_reason": by_reason,
+        "shed_rate": round(by_reason.get("shed", 0) / n, 4),
+        "deadline_miss_rate": round(by_reason.get("deadline", 0) / n, 4),
+        "quarantined": by_reason.get("error", 0),
+        "completed_ok": len(ok),
+        "nonfaulted_p50_ms": (
+            round(float(np.percentile(lat, 50)) * 1e3, 3) if lat else 0.0
+        ),
+        "nonfaulted_p99_ms": (
+            round(float(np.percentile(lat, 99)) * 1e3, 3) if lat else 0.0
+        ),
+    }
+
+
 def run_arm(model, params, arm: str, args, flops_per_token: int) -> dict:
     """One (decode impl, sharding) arm through the engine; returns the
     BENCH_TABLE-schema row."""
@@ -206,15 +280,14 @@ def run_arm(model, params, arm: str, args, flops_per_token: int) -> dict:
             )
         env = build_mesh(MeshConfig(data=n // tp, model=tp))
         mesh_sizes.update(data=n // tp, model=tp)
-        ctx = mesh_context(env)
-        with ctx:
+        with mesh_context(env):
             run_params = shard_params_for_serving(params, env, gpt_tp_rules())
     else:
-        ctx = mesh_context(None)
+        env = None
         run_params = params
 
     work = _workload(model.config, args.requests, args.max_new, args.seed)
-    with ctx:
+    with mesh_context(env):
         eng = ServingEngine(
             model, run_params, num_slots=args.slots, temperature=0.0
         )
@@ -237,6 +310,10 @@ def run_arm(model, params, arm: str, args, flops_per_token: int) -> dict:
         done = eng.run()
         wall = time.perf_counter() - t0
     assert len(done) == len(work), (len(done), len(work))
+    chaos = None
+    if args.chaos:
+        with mesh_context(env):
+            chaos = _chaos_pass(model, run_params, args, work)
 
     # Capacity accounting (the quantized-cache arms' raison d'être):
     # actual per-slot bytes of the terminal-bucket engine cache (scale
@@ -304,6 +381,7 @@ def run_arm(model, params, arm: str, args, flops_per_token: int) -> dict:
             "max_slots_at_hbm_bf16_ref": hbm_budget // max(bytes_bf16_ref, 1),
             "hbm_budget_gb": args.hbm_gb,
             "engine_stats": dict(eng.stats),
+            **({"chaos": chaos} if chaos is not None else {}),
         },
         "note": (
             "continuous-batching serve bench (tools/serve_bench.py): "
@@ -352,6 +430,15 @@ def main(argv=None) -> int:
             f"{s['max_slots_at_hbm']:>8d} slots@{s['hbm_budget_gb']:g}G",
             file=sys.stderr,
         )
+        if "chaos" in s:
+            c = s["chaos"]
+            print(
+                f"# {'chaos':>23s}: shed {c['shed_rate']:.0%}  "
+                f"deadline-miss {c['deadline_miss_rate']:.0%}  "
+                f"quarantined {c['quarantined']}  "
+                f"non-faulted p99 {c['nonfaulted_p99_ms']:.2f} ms",
+                file=sys.stderr,
+            )
     return 0
 
 
