@@ -223,6 +223,13 @@ def _emit_stale_or_error(error: str) -> int:
         rec = _table_fallback_record()
     if rec and "value" in rec:
         rec["stale"] = True
+        # The typed status stamp (the BENCH_TABLE vocabulary: "queued"
+        # placeholders, "stale" re-emissions): anything consuming the
+        # final line — or a table this record gets appended to — can
+        # filter on status without parsing the boolean + reason pair,
+        # and the schema test refuses a stale row wearing a fresh face
+        # (no captured_at) or a measured one wearing "stale".
+        rec["status"] = "stale"
         rec["stale_reason"] = error[:300]
         _progress(
             f"relay down ({error[:120]}); re-emitting last good capture "

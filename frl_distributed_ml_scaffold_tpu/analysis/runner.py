@@ -537,6 +537,185 @@ def lint_decode_step(
     return report
 
 
+def build_paged_decode_step_program(
+    *, seq_len: int = 96, block_size: int = 16, pool_blocks: int = 9,
+    num_slots: int = 2, kv_cache_quant: str = "none",
+):
+    """The tiny-GPT PAGED serving decode step as an ABSTRACT program
+    (ISSUE 10): ``(model, params, cache, tok, jaxpr)``, all shapes
+    eval_shape'd — nothing runs. The cache is the block POOL (per-layer
+    K/V block pools + block tables + index bookkeeping), so the program
+    is the block-table decode shape the paged engine compiles ONCE.
+    Shared by ``lint_paged_decode_step`` and the perf ledger, like its
+    bucketed sibling ``build_decode_step_program``."""
+    import jax
+    import jax.numpy as jnp
+
+    from frl_distributed_ml_scaffold_tpu.config.schema import (
+        GPTConfig,
+        PrecisionConfig,
+    )
+    from frl_distributed_ml_scaffold_tpu.models.generation import (
+        _decode_step,
+    )
+    from frl_distributed_ml_scaffold_tpu.models.gpt import GPT
+    from frl_distributed_ml_scaffold_tpu.precision import get_policy
+
+    model = GPT(
+        GPTConfig(
+            vocab_size=64, num_layers=2, num_heads=2, hidden_dim=32,
+            seq_len=seq_len, dropout=0.0, kv_cache_quant=kv_cache_quant,
+        ),
+        get_policy(PrecisionConfig(policy="fp32")),
+    )
+    m = model.clone(kv_block_size=block_size, kv_pool_blocks=pool_blocks)
+    tok = jax.ShapeDtypeStruct((num_slots, 1), jnp.int32)
+    params = jax.eval_shape(
+        lambda: model.init(
+            {"params": jax.random.key(0)},
+            jnp.zeros((num_slots, 4), jnp.int32),
+            train=False,
+        )["params"]
+    )
+    _, cache_vars = jax.eval_shape(
+        lambda p, t: m.apply(
+            {"params": p}, t, decode=True, mutable=["cache"]
+        ),
+        params, tok,
+    )
+    cache = cache_vars["cache"]
+
+    jaxpr = jax.make_jaxpr(
+        lambda p, c, t: _decode_step(m, p, c, t[:, 0])
+    )(params, cache, tok)
+    return model, params, cache, tok, jaxpr
+
+
+def _max_pool_leaf_bytes(cache) -> int:
+    """The largest block-pool leaf in a paged cache tree — the paged
+    decode step's legal materialization ceiling (its biggest intermediate
+    is the donated in-place pool update, which is exactly pool-sized)."""
+    import jax
+    import numpy as np
+
+    from frl_distributed_ml_scaffold_tpu.models.generation import (
+        SLOT_LEAF_OF,
+    )
+
+    best = 0
+    for path, leaf in jax.tree_util.tree_flatten_with_path(cache)[0]:
+        if getattr(path[-1], "key", None) in SLOT_LEAF_OF:
+            best = max(
+                best,
+                int(np.prod(leaf.shape, dtype=np.int64))
+                * np.dtype(leaf.dtype).itemsize,
+            )
+    return best
+
+
+def lint_paged_decode_step(
+    *, seq_len: int = 96, block_size: int = 16, pool_blocks: int = 9,
+    num_slots: int = 2, kv_cache_quant: str = "none",
+) -> Report:
+    """Lint the PAGED serving decode step (ISSUE 10) — the
+    ``assert_no_cache_clone`` discipline, as two teeth:
+
+    - no full-``seq_len`` intermediate: gathering the logical cache view
+      out of the pool (``pool[tables]`` reshaped contiguous) is exactly
+      the full-context materialization paging exists to avoid;
+    - materialization budget == the largest pool leaf: the step's
+      biggest legal array is the donated in-place pool update, so any
+      clone-per-grow regression (pad the pool, copy it wider) has to
+      materialize MORE than one pool and trips the budget.
+
+    Plus the engine donation audit: the paged decode program donates
+    every cache leaf (pool included) — without it each step holds two
+    POOLS live, a far bigger spike than the bucketed double-cache.
+    Mutation-gated in tests/test_graft_lint.py (a clone-per-grow mutant
+    and a gather-the-logical-cache mutant must both trip)."""
+    import jax
+    import jax.numpy as jnp
+
+    from frl_distributed_ml_scaffold_tpu.serving.engine import ServingEngine
+
+    quant = kv_cache_quant != "none"
+    report = Report(
+        program="serving:decode_step_paged_int8kv" if quant
+        else "serving:decode_step_paged"
+    )
+    model, params, cache, tok, jaxpr = build_paged_decode_step_program(
+        seq_len=seq_len, block_size=block_size, pool_blocks=pool_blocks,
+        num_slots=num_slots, kv_cache_quant=kv_cache_quant,
+    )
+
+    census = collective_census(jaxpr)
+    report.meta["collective_census"] = [r.to_dict() for r in census]
+    report.extend(
+        materialization_findings(
+            jaxpr, forbidden_dim=seq_len, label="paged_decode_step: "
+        )
+    )
+    budget = _max_pool_leaf_bytes(cache)
+    report.meta["pool_leaf_bytes"] = budget
+    from frl_distributed_ml_scaffold_tpu.analysis.materialization import (
+        oversized_intermediates,
+    )
+
+    for i in oversized_intermediates(jaxpr, budget):
+        report.add(
+            "materialization", "error", "cache-clone",
+            f"paged decode step materializes {i.dtype}{list(i.shape)} "
+            f"({i.bytes} bytes > the {budget}-byte pool leaf, "
+            f"{i.primitive}) — growth must append a block to a table, "
+            "never clone/pad the pool",
+            intermediate=i.to_dict(), budget_bytes=budget,
+        )
+
+    # Engine donation audit on the ONE paged decode program.
+    from frl_distributed_ml_scaffold_tpu.analysis.donation import (
+        args_info_donations,
+        lowered_donations,
+    )
+
+    eng = ServingEngine(
+        model, params, num_slots=num_slots, temperature=0.0,
+        kv_block_size=block_size, kv_pool_blocks=pool_blocks,
+    )
+    rng = jax.eval_shape(lambda: jax.random.key(0))
+    flat_tok = jax.ShapeDtypeStruct((num_slots,), jnp.int32)
+    dec_lowered = eng._paged_decode_fn().lower(params, cache, flat_tok, rng)
+    n_cache = len(jax.tree.leaves(cache))
+    pairs = args_info_donations(dec_lowered)
+    if pairs is None:
+        dons = [d.donated for d in lowered_donations(dec_lowered.as_text())]
+        if sum(dons) < n_cache:
+            report.add(
+                "donation", "error", "cache-not-donated",
+                f"paged decode step donates {sum(dons)} args but the "
+                f"pool cache has {n_cache} leaves — two POOLS live per "
+                "step",
+                donated=sum(dons), cache_leaves=n_cache,
+            )
+        return report
+    undonated_cache = [
+        p for p, d in pairs if p.startswith("[0][1]") and not d
+    ]
+    for p in undonated_cache:
+        report.add(
+            "donation", "error", "cache-not-donated",
+            f"paged decode step does not donate cache leaf {p} — the "
+            "engine holds two POOLS live per step",
+            path=p,
+        )
+    if not undonated_cache:
+        report.add(
+            "donation", "info", "summary",
+            f"paged decode step donates all {n_cache} cache leaves "
+            f"({sum(1 for _, d in pairs if d)}/{len(pairs)} args donated)",
+        )
+    return report
+
+
 def lint_hygiene(paths: Iterable[str] | None = None) -> Report:
     """AST hygiene lint over the repo's traced modules."""
     import glob
@@ -629,6 +808,11 @@ def lint_all(
         # in production (model.kv_cache_quant) — lint it as its own
         # program, with the dequantized-cache pin armed.
         emit(lint_decode_step(kv_cache_quant="int8"))
+        # The paged (block-table) decode step (ISSUE 10): the engine's
+        # ONE compiled decode shape, with the no-cache-clone budget and
+        # the no-logical-gather pin armed — plus its int8-pool flavor.
+        emit(lint_paged_decode_step())
+        emit(lint_paged_decode_step(kv_cache_quant="int8"))
     if hygiene:
         emit(lint_hygiene())
     if robustness:

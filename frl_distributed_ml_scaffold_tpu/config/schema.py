@@ -250,6 +250,23 @@ class ServingConfig:
     # far, freeing the slot for refill. submit(deadline_s=...) overrides
     # per request. 0 = no deadline.
     default_deadline_s: float = 0.0
+    # Paged KV cache (ISSUE 10): > 0 stores K/V in a shared pool of
+    # fixed-size blocks (power of two) with per-slot block tables —
+    # slots stop reserving power-of-two cache buckets, growth appends a
+    # block instead of cloning the cache, and HBM is priced per BLOCK.
+    # 0 = the bucketed contiguous cache (pre-ISSUE-10 behavior).
+    kv_block_size: int = 0
+    # Pool size in blocks (block 0 is the reserved trash block retired
+    # slots write into). 0 = auto: num_slots x ceil(seq_len/block) + 1,
+    # the never-blocks-admission worst case — size it DOWN deliberately
+    # to multiply concurrency (admission then waits on pool headroom,
+    # composing with max_queue_depth's shed bound; docs/operations.md).
+    kv_pool_blocks: int = 0
+    # Refcounted shared-prefix caching over full pool blocks: a prompt
+    # whose leading blocks match an earlier prompt's reuses them
+    # (prefill runs only on the suffix); the first divergent or partial
+    # block is copy-on-write private, so shared blocks are immutable.
+    prefix_cache: bool = True
 
 
 @dataclass(frozen=True)

@@ -88,14 +88,25 @@ def _take_logits(out):
 
 
 def _prefill(model: Any, params: Any, prompt: jax.Array,
-             lengths: jax.Array | None):
+             lengths: jax.Array | None, cache: Any = None):
     """One pass over the (possibly left-padded ragged) prompt creates +
     fills every layer's KV cache; returns (last-position logits [B, V],
     cache). Prompts are right-aligned, so logits[:, -1] is every row's
     real last token regardless of raggedness. The SHARED decode entry:
-    generate and beam_search both start here, so they cannot drift."""
+    generate and beam_search both start here, so they cannot drift.
+
+    ``cache`` seeds the cache collection instead of the lazy zero init:
+    the serving engine's shared-prefix path prefills only a prompt's
+    SUFFIX against an initial cache whose leading positions hold the
+    shared prefix's K/V (gathered block-wise from the pool) and whose
+    ``cache_index``/``pos_index`` start at the prefix length — the
+    attention math is then identical to a full-prompt prefill, minus
+    the prefix tokens' projection/score work."""
+    variables = {"params": params}
+    if cache is not None:
+        variables["cache"] = cache
     logits, vars_out = model.apply(
-        {"params": params}, prompt, decode=True, lengths=lengths,
+        variables, prompt, decode=True, lengths=lengths,
         mutable=["cache"],
     )
     return _take_logits(logits)[:, -1], vars_out["cache"]
@@ -279,6 +290,79 @@ def estimate_cache_bytes_per_slot(
         per_layer += 2 * cache_len * h * 2  # bf16 scale per (pos, head)
     per_layer += 4  # cache_index int32
     return cfg.num_layers * per_layer + 4  # + pos_index int32
+
+
+# --------------------------------------------------------- paged (block) pool
+#
+# The PAGED decode cache (ISSUE 10) replaces per-slot [B, S, ...] stacks
+# with a shared pool of fixed-size blocks plus per-row block tables.  The
+# taxonomy below is the paged extension of cache_batch_axis /
+# cache_capacity_axis: pool leaves carry NO row axis (blocks are shared —
+# that is the whole point) and are classified by NAME, because a pool's
+# [N, bs, H, hd] shape is indistinguishable from a slot cache's
+# [B, S, H, hd] by shape alone. Every block-wise cache transform — the
+# engine's block grafts, the prefix-seed gather, capacity accounting —
+# routes through these names, the same lockstep contract as the shape
+# taxonomy.
+
+#: Slot-cache leaf name -> its pool counterpart (the contiguous prefill
+#: cache's leaves map onto pool blocks through this; the scale leaves are
+#: the PR 6 format vocabulary, preserved block-wise).
+POOL_LEAF_OF: dict[str, str] = {
+    "cached_key": "key_pool",
+    "cached_value": "value_pool",
+    "key_scale": "key_pool_scale",
+    "value_scale": "value_pool_scale",
+}
+
+#: Pool leaf name -> slot-cache leaf name (the reverse direction: the
+#: prefix-seed gather reconstructs a contiguous prefix from pool blocks).
+SLOT_LEAF_OF: dict[str, str] = {v: k for k, v in POOL_LEAF_OF.items()}
+
+
+def blocks_for_tokens(tokens: int, block_size: int) -> int:
+    """Blocks needed to hold ``tokens`` cache positions (ceil)."""
+    if tokens <= 0:
+        return 0
+    return -(-int(tokens) // int(block_size))
+
+
+def pool_block_bytes(cache) -> int:
+    """HBM bytes of ONE pool block across all layers — K/V payloads AND
+    quantization-scale blocks, from the ACTUAL pool leaves (the paged
+    analog of ``cache_bytes_per_slot``: the unit the engine's
+    pool-utilization accounting and serve_bench's paged capacity columns
+    price admissions in)."""
+    import numpy as np
+
+    total = 0
+    for path, leaf in jax.tree_util.tree_flatten_with_path(cache)[0]:
+        name = getattr(path[-1], "key", None)
+        if name in SLOT_LEAF_OF:
+            # [L, N, bs, ...] stacked pool leaf: bytes per (all-layers) block.
+            n = leaf.shape[1]
+            total += (
+                int(np.prod(leaf.shape, dtype=np.int64)) // n
+            ) * jnp.dtype(leaf.dtype).itemsize
+    return int(total)
+
+
+def estimate_pool_block_bytes(
+    cfg: Any, block_size: int, *, kv_dtype_bytes: int = 2
+) -> int:
+    """Analytic twin of ``pool_block_bytes`` for capacity planning BEFORE
+    a pool exists: one block of ``block_size`` positions costs
+    ``L x 2 x bs x H x hd`` payload bytes (+ the bf16 scale blocks under
+    ``cfg.kv_cache_quant``). Pinned equal to the actual pool tree in
+    tests/test_serving.py, like ``estimate_cache_bytes_per_slot``."""
+    h = cfg.num_heads
+    hd = cfg.hidden_dim // h
+    quant = getattr(cfg, "kv_cache_quant", "none") != "none"
+    elem = 1 if quant else kv_dtype_bytes
+    per_layer = 2 * block_size * h * hd * elem
+    if quant:
+        per_layer += 2 * block_size * h * 2  # bf16 scale per (pos, head)
+    return cfg.num_layers * per_layer
 
 
 def _gather_cache_rows(cache, rows, batch_rows: int):

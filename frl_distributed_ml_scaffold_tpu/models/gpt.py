@@ -129,6 +129,29 @@ def _masked_dense_attention(q, k, v, mask):
     return out.astype(q.dtype)
 
 
+def _constrain_kv_pool(x: jnp.ndarray) -> jnp.ndarray:
+    """Pin a PAGED cache leaf — [N, bs, H, hd] K/V pool blocks or their
+    [N, bs, H] scale pools — model-sharded over the mesh's ``model`` axis
+    (heads on axis 2, the same Megatron split as ``_constrain_kv_cache``)
+    and REPLICATED over the batch axes: pool blocks are shared across
+    slot rows (that is what multiplies concurrency), so a batch-sharded
+    pool would scatter a row's blocks across data shards and every table
+    lookup would become a cross-shard gather."""
+    from frl_distributed_ml_scaffold_tpu.dist.mesh import current_mesh_env
+
+    env = current_mesh_env()
+    if env is None or env.axis_size("model") <= 1:
+        return x
+    if x.ndim < 3 or x.shape[2] % env.axis_size("model") != 0:
+        return x
+    from jax.sharding import NamedSharding
+
+    spec = P(None, None, "model", *([None] * (x.ndim - 3)))
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(env.mesh, spec)
+    )
+
+
 def _constrain_kv_cache(x: jnp.ndarray) -> jnp.ndarray:
     """Pin a cache leaf — [B, S, H, hd] K/V values or their [B, S, H]
     quantization scales — model-sharded over the mesh's ``model`` axis
@@ -174,6 +197,12 @@ class CausalSelfAttention(nn.Module):
     # cache to a power of two covering prompt+budget so short requests
     # stop paying full-context cache traffic (serving/engine.py policy).
     cache_len: int = 0
+    # Paged decode cache (ISSUE 10; 0 = contiguous per-row cache): K/V
+    # live in a shared pool of kv_pool_blocks fixed-size blocks instead
+    # of [B, S] stacks; the per-row block table arrives via the scan
+    # carry (serving/engine.py owns allocation and the tables).
+    kv_block_size: int = 0
+    kv_pool_blocks: int = 0
 
     @nn.compact
     def __call__(
@@ -183,6 +212,7 @@ class CausalSelfAttention(nn.Module):
         train: bool,
         decode: bool = False,
         lengths: jnp.ndarray | None = None,
+        block_tables: jnp.ndarray | None = None,
     ) -> jnp.ndarray:
         cfg = self.config
         d = cfg.hidden_dim
@@ -231,6 +261,115 @@ class CausalSelfAttention(nn.Module):
                 cache_dtype = lowp_dtype(cfg.kv_cache_quant)
             else:
                 cache_dtype = self.dtype
+            if self.kv_block_size > 0:
+                # PAGED cache (ISSUE 10): K/V live in a POOL of
+                # fixed-size blocks shared by every row; this row's
+                # logical block j is physical pool block
+                # block_tables[b, j]. Only single-token steps run paged —
+                # prefill stays contiguous (serving/engine.py grafts the
+                # prefilled blocks into the pool, moving exactly the
+                # blocks that change owner). Shared-prefix blocks are
+                # immutable by construction: a row's writes land at
+                # positions >= its private suffix, and the engine's
+                # copy-on-write admission never maps a shared block
+                # there.
+                if t != 1:
+                    raise NotImplementedError(
+                        "paged decode runs single-token steps only; "
+                        "prefill uses the contiguous slot cache and the "
+                        "engine grafts it block-wise into the pool"
+                    )
+                if block_tables is None:
+                    raise ValueError(
+                        "kv_block_size set but no block_tables reached "
+                        "the attention cache — the decode carry must "
+                        "thread them"
+                    )
+                bs_blk, nb = self.kv_block_size, self.kv_pool_blocks
+                ck = self.variable(
+                    "cache", "key_pool", jnp.zeros,
+                    (nb, bs_blk, h, hd), cache_dtype,
+                )
+                cv = self.variable(
+                    "cache", "value_pool", jnp.zeros,
+                    (nb, bs_blk, h, hd), cache_dtype,
+                )
+                if quant:
+                    ksc = self.variable(
+                        "cache", "key_pool_scale", jnp.zeros,
+                        (nb, bs_blk, h), jnp.bfloat16,
+                    )
+                    vsc = self.variable(
+                        "cache", "value_pool_scale", jnp.zeros,
+                        (nb, bs_blk, h), jnp.bfloat16,
+                    )
+                ci = self.variable(
+                    "cache", "cache_index", jnp.zeros, (b,), jnp.int32
+                )
+                idx = ci.value  # [B]
+                # Physical write target: block tbl[idx // bs], offset
+                # idx % bs. Retired slots point at the reserved trash
+                # block 0 (and their index keeps advancing), so the
+                # lookup clamps to the table width instead of trusting
+                # idx to stay inside the logical capacity.
+                m_tbl = block_tables.shape[1]
+                phys = jnp.take_along_axis(
+                    block_tables.astype(jnp.int32),
+                    jnp.minimum(idx // bs_blk, m_tbl - 1)[:, None],
+                    axis=1,
+                )[:, 0]  # [B]
+                off = idx % bs_blk
+                k_w = k[:, 0].astype(self.dtype)  # [B, H, hd]
+                v_w = v[:, 0].astype(self.dtype)
+                if quant:
+                    from frl_distributed_ml_scaffold_tpu.ops.quantization import (
+                        quantize,
+                    )
+
+                    # Quantize ONCE per written token over its own head
+                    # vector (the PR 6 contract): per-(row, head) scales
+                    # over hd, identical to the contiguous path's
+                    # per-(row, pos, head) scale at this position.
+                    qk, sk = quantize(
+                        k_w, cfg.kv_cache_quant, channel_axes=(0, 1)
+                    )
+                    qv, sv = quantize(
+                        v_w, cfg.kv_cache_quant, channel_axes=(0, 1)
+                    )
+                    k_w, v_w = qk, qv
+                    ksc.value = _constrain_kv_pool(
+                        ksc.value.at[phys, off].set(
+                            sk[..., 0].astype(ksc.value.dtype)
+                        )
+                    )
+                    vsc.value = _constrain_kv_pool(
+                        vsc.value.at[phys, off].set(
+                            sv[..., 0].astype(vsc.value.dtype)
+                        )
+                    )
+                ck.value = _constrain_kv_pool(
+                    ck.value.at[phys, off].set(k_w)
+                )
+                cv.value = _constrain_kv_pool(
+                    cv.value.at[phys, off].set(v_w)
+                )
+                from frl_distributed_ml_scaffold_tpu.ops.decode_attention import (
+                    paged_decode_attention,
+                )
+
+                y = paged_decode_attention(
+                    q[:, 0], ck.value, cv.value, idx + 1, block_tables,
+                    k_scale=ksc.value if quant else None,
+                    v_scale=vsc.value if quant else None,
+                    impl=cfg.decode_attention,
+                )[:, None]
+                ci.value = idx + 1
+                y = y.reshape(b, t, d)
+                y = nn.Dense(
+                    d, dtype=self.dtype, name="out", dot_general=out_dg
+                )(y)
+                y = nn.Dropout(cfg.dropout, deterministic=not train)(y)
+                return y
             # Cache vars are created lazily on first use: flax permits
             # variable creation during apply when the collection is mutable.
             ck = self.variable(
@@ -276,7 +415,14 @@ class CausalSelfAttention(nn.Module):
                     v_w, roll_cols[:, :, None, None], axis=1
                 )
             rows = jnp.arange(b)[:, None]
-            write_cols = jnp.clip(idx[:, None] + jnp.arange(t)[None, :], 0, s - 1)
+            # Columns past the cache capacity are DROPPED, not clipped:
+            # a seeded suffix prefill (serving shared-prefix admission,
+            # cache_index starting at the prefix length) can push its
+            # trailing wrapped-pad garbage columns past ``s`` — clipping
+            # would pile them onto position s-1, clobbering a real
+            # token's K/V. The same drop also silences retired serving
+            # rows whose index has advanced past capacity.
+            write_cols = idx[:, None] + jnp.arange(t)[None, :]
             if quant:
                 from frl_distributed_ml_scaffold_tpu.ops.quantization import (
                     dequantize,
@@ -290,19 +436,19 @@ class CausalSelfAttention(nn.Module):
                 k_w, v_w = qk, qv  # [B, t, H, hd] 1-byte payloads
                 ksc.value = _constrain_kv_cache(
                     ksc.value.at[rows, write_cols].set(
-                        sk[..., 0].astype(ksc.value.dtype)
+                        sk[..., 0].astype(ksc.value.dtype), mode="drop"
                     )
                 )
                 vsc.value = _constrain_kv_cache(
                     vsc.value.at[rows, write_cols].set(
-                        sv[..., 0].astype(vsc.value.dtype)
+                        sv[..., 0].astype(vsc.value.dtype), mode="drop"
                     )
                 )
             ck.value = _constrain_kv_cache(
-                ck.value.at[rows, write_cols].set(k_w)
+                ck.value.at[rows, write_cols].set(k_w, mode="drop")
             )
             cv.value = _constrain_kv_cache(
-                cv.value.at[rows, write_cols].set(v_w)
+                cv.value.at[rows, write_cols].set(v_w, mode="drop")
             )
             if t == 1:
                 from frl_distributed_ml_scaffold_tpu.ops.decode_attention import (
@@ -403,21 +549,31 @@ class Block(nn.Module):
     decode: bool = False  # KV-cache incremental decoding
     tp: Any = None  # collective-matmul TP hooks (parallel/tp_overlap.py)
     cache_len: int = 0  # decode cache bucket (0 = config.seq_len)
+    kv_block_size: int = 0  # paged decode pool (0 = contiguous cache)
+    kv_pool_blocks: int = 0
 
     @nn.compact
     def __call__(self, carry, _unused):
         # Decode mode threads the per-row prompt lengths through the scan
         # carry (a traced array cannot be a module attribute); they are
-        # loop-invariant.
-        if self.decode:
+        # loop-invariant. Paged decode additionally threads the per-row
+        # block tables the same way (every layer reads the same tables;
+        # the pools themselves are per-layer cache vars).
+        tables = None
+        if self.decode and self.kv_block_size > 0:
+            x, aux_loss, lengths, tables = carry
+        elif self.decode:
             x, aux_loss, lengths = carry
         else:
             (x, aux_loss), lengths = carry, None
         cfg, train, tp = self.config, self.train, self.tp
         y = nn.LayerNorm(dtype=jnp.float32, epsilon=cfg.layer_norm_epsilon, name="ln1")(x)
         attn_out = CausalSelfAttention(
-            cfg, self.dtype, tp=tp, cache_len=self.cache_len, name="attn"
-        )(y, train=train, decode=self.decode, lengths=lengths)
+            cfg, self.dtype, tp=tp, cache_len=self.cache_len,
+            kv_block_size=self.kv_block_size,
+            kv_pool_blocks=self.kv_pool_blocks, name="attn"
+        )(y, train=train, decode=self.decode, lengths=lengths,
+          block_tables=tables)
         # Named for block_remat="save_attn": saving this one [B,T,D] tensor
         # per layer lets the per-block recompute skip the attention sublayer
         # (the quadratic part). A no-op unless a checkpoint policy asks.
@@ -441,6 +597,8 @@ class Block(nn.Module):
         x = x + mlp_out
         if tp is not None:
             x = tp.constrain_stream(x)
+        if self.decode and self.kv_block_size > 0:
+            return (x, aux_loss, lengths, tables), None
         if self.decode:
             return (x, aux_loss, lengths), None
         return (x, aux_loss), None
@@ -469,6 +627,14 @@ class GPT(nn.Module):
     # arrays — and everything that reads them — are sized to the request
     # window, not the model's maximum context.
     cache_len: int = 0
+    # Paged decode cache (ISSUE 10; engine-set via clone, like cache_len):
+    # kv_block_size > 0 stores K/V in a shared pool of kv_pool_blocks
+    # fixed-size blocks addressed through a per-row ``block_tables``
+    # cache var ([B, ceil(seq_len/block_size)] int32, engine-owned) —
+    # single-token decode steps only; prefill stays contiguous and the
+    # engine grafts it into the pool block-wise.
+    kv_block_size: int = 0
+    kv_pool_blocks: int = 0
 
     @nn.compact
     def __call__(
@@ -621,9 +787,24 @@ class GPT(nn.Module):
                 decode,
                 None if decode else self.tp_overlap,
                 self.cache_len if decode else 0,
+                self.kv_block_size if decode else 0,
+                self.kv_pool_blocks if decode else 0,
                 name="blocks",
             )
-            if decode:
+            if decode and self.kv_block_size > 0:
+                # Paged decode: the block tables are a MODEL-level cache
+                # var (one copy, not per-layer — every layer reads the
+                # same row→block mapping), threaded to the scanned blocks
+                # through the carry like `lens`. The engine writes them
+                # host-side between steps; the model only reads.
+                m_blocks = -(-cfg.seq_len // self.kv_block_size)
+                tbl = self.variable(
+                    "cache", "block_tables", jnp.zeros,
+                    (b, m_blocks), jnp.int32,
+                )
+                carry0 = (x, jnp.zeros((), jnp.float32), lens, tbl.value)
+                (x, aux_loss, _, _), _ = blocks(carry0, None)
+            elif decode:
                 # `lens` from the position block above — one defaulting
                 # site for the whole decode trace.
                 carry0 = (x, jnp.zeros((), jnp.float32), lens)

@@ -46,6 +46,22 @@ the same property by streaming bounded chunks through an online-softmax
 ``lax.scan`` (``dense_decode_attention_quant``); graft-lint pins that no
 wide-dtype cache-shaped intermediate materializes in a quantized decode
 step.
+
+Paged KV cache (ISSUE 10, ROADMAP item 1): the serving engine stores K/V
+in a fixed POOL of fixed-size blocks ``[N, bs, H, D]`` shared by every
+slot, with a per-row block table ``[B, M]`` mapping each row's logical
+block j to a physical pool block (serving/engine.py owns allocation,
+refcounts, and shared-prefix reuse). ``paged_decode_attention`` extends
+the split-KV kernel through the SAME scalar-prefetch path: the block
+table rides the prefetch channel next to the per-row lengths, so the
+K/V index maps gather block-by-block — chunk j of row b DMAs pool block
+``table[b, j]``, clamped to the row's last occupied block exactly like
+the contiguous kernel clamps its chunk index. Nothing is ever gathered
+into a contiguous logical view: the dense fallback streams bounded
+``[B, bs, H, D]`` chunks (one ``jnp.take`` per table column) through the
+same online-softmax ``lax.scan``, so no full-``seq_len`` array — and no
+pool-sized copy — materializes per step (graft-lint's paged decode
+program pins both).
 """
 
 from __future__ import annotations
@@ -171,6 +187,76 @@ def dense_decode_attention_quant(
     return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
 
+def dense_paged_decode_attention(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    kv_len: jax.Array,
+    block_tables: jax.Array,
+    k_scale: jax.Array | None = None,
+    v_scale: jax.Array | None = None,
+) -> jax.Array:
+    """Reference decode attention over a PAGED cache: q ``[B, H, D]``
+    against pool blocks ``[N, bs, H, D]`` addressed through per-row block
+    tables ``[B, M]`` (row b's logical positions ``[j*bs, (j+1)*bs)``
+    live in pool block ``block_tables[b, j]``), keys at logical positions
+    >= ``kv_len[b]`` masked out. With ``k_scale``/``v_scale``
+    (``[N, bs, H]``) the pool is quantized and the scales fold into the
+    score strip / probability row per chunk.
+
+    Deliberately NOT "gather the logical cache, call the contiguous
+    reference": that materializes an ``M*bs >= seq_len``-wide tensor
+    every decode step — exactly the full-context array the block pool
+    exists to avoid (and the graft-lint mutation gate for the paged
+    program). Instead the table columns stream through an online-softmax
+    ``lax.scan``: each iteration gathers ONE bounded ``[B, bs, H, D]``
+    block per row (``jnp.take`` on the physical ids — gather at the
+    boundary, the arXiv 2112.01075 discipline) and merges with the
+    standard log-sum-exp rescale. fp32 softmax throughout (the decode
+    numerics contract)."""
+    _, bs, h, d = k_pool.shape
+    quant = k_scale is not None
+    q32 = q.astype(jnp.float32)
+    inv = 1.0 / np.sqrt(d)
+    cols = block_tables.astype(jnp.int32).T  # [M, B] physical ids per step
+
+    def step(carry, phys):
+        m, l, acc, j = carry
+        k_c = jnp.take(k_pool, phys, axis=0)  # [B, bs, H, D] — bounded
+        v_c = jnp.take(v_pool, phys, axis=0)
+        sc = jnp.einsum(
+            "bhd,bchd->bhc", q32, k_c.astype(jnp.float32)
+        )
+        if quant:
+            k_s = jnp.take(k_scale, phys, axis=0).astype(jnp.float32)
+            sc = sc * jnp.moveaxis(k_s, 1, 2)  # scale per (b, h, pos)
+        sc = sc * inv
+        kpos = j * bs + jnp.arange(bs)
+        mask = kpos[None, None, :] < kv_len[:, None, None]
+        sc = jnp.where(mask, sc, _NEG_INF)
+        m_new = jnp.maximum(m, sc.max(axis=-1, keepdims=True))
+        p = jnp.where(mask, jnp.exp(sc - m_new), 0.0)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + p.sum(axis=-1, keepdims=True)
+        if quant:
+            v_s = jnp.take(v_scale, phys, axis=0).astype(jnp.float32)
+            p = p * jnp.moveaxis(v_s, 1, 2)  # fold v scales into the probs
+        acc = acc * alpha + jnp.einsum(
+            "bhc,bchd->bhd", p, v_c.astype(jnp.float32)
+        )
+        return (m_new, l, acc, j + 1), None
+
+    b = q.shape[0]
+    carry0 = (
+        jnp.full((b, h, 1), _NEG_INF, jnp.float32),
+        jnp.zeros((b, h, 1), jnp.float32),
+        jnp.zeros((b, h, d), jnp.float32),
+        jnp.int32(0),
+    )
+    (m, l, acc, _), _ = jax.lax.scan(step, carry0, cols)
+    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
 # ------------------------------------------------------------------ kernel
 
 
@@ -272,6 +358,107 @@ def _decode_kernel_quant(len_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref,
         o_ref[0, :, 0, :] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
 
 
+def _paged_decode_kernel(len_ref, tbl_ref, q_ref, k_ref, v_ref, o_ref,
+                         m_ref, l_ref, acc_ref, *, block_k, scale):
+    """Paged sibling of ``_decode_kernel``: one (batch row, logical
+    block) program. The block table is consumed by the INDEX MAPS (it
+    rides the scalar-prefetch channel, so the physical block id is known
+    before the body runs and the DMA fetches pool block
+    ``tbl_ref[b, j]`` directly); the body itself only needs the length
+    mask — pool blocks arrive in their storage layout ``(bs, H, D)``, so
+    the dots batch over the MIDDLE heads dim instead of transposing the
+    pool."""
+    b_, j = pl.program_id(0), pl.program_id(1)
+    n_k = pl.num_programs(1)
+    length = len_ref[b_]
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when(j * block_k < length)
+    def _step():
+        q = q_ref[0]  # (H, D)
+        k_blk = k_ref[0]  # (Bk, H, D) — pool-block storage layout
+        v_blk = v_ref[0]
+        # (H, D) x (Bk, H, D) -> (H, Bk): batch over H (rhs dim 1).
+        s = lax.dot_general(
+            q, k_blk,
+            dimension_numbers=(((1,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        kpos = j * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(kpos < length, s, _NEG_INF)
+        m = m_ref[:]
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        m_ref[:] = m_new
+        l_ref[:] = l_ref[:] * alpha + p.sum(axis=-1, keepdims=True)
+        # (H, Bk) x (Bk, H, D) -> (H, D): batch over H, contract Bk.
+        acc_ref[:] = acc_ref[:] * alpha + lax.dot_general(
+            p.astype(v_blk.dtype), v_blk,
+            dimension_numbers=(((1,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == n_k - 1)
+    def _finish():
+        l_safe = jnp.maximum(l_ref[:], 1e-30)
+        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+
+
+def _paged_decode_kernel_quant(len_ref, tbl_ref, q_ref, k_ref, ks_ref,
+                               v_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref,
+                               *, block_k, scale):
+    """Quantized-pool sibling: 1-byte blocks upcast in VMEM, per-(pos,
+    head) scales fold into the score strip / probability row after the
+    dots — same per-chunk dequantize contract as ``_decode_kernel_quant``,
+    addressed through the block table."""
+    b_, j = pl.program_id(0), pl.program_id(1)
+    n_k = pl.num_programs(1)
+    length = len_ref[b_]
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when(j * block_k < length)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)  # (H, D)
+        k_blk = k_ref[0].astype(jnp.float32)  # (Bk, H, D) — VMEM upcast
+        v_blk = v_ref[0].astype(jnp.float32)
+        k_s = ks_ref[0]  # (Bk, H) fp32 scales
+        v_s = vs_ref[0]
+        s = lax.dot_general(
+            q, k_blk,
+            dimension_numbers=(((1,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32,
+        ) * jnp.swapaxes(k_s, 0, 1) * scale
+        kpos = j * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(kpos < length, s, _NEG_INF)
+        m = m_ref[:]
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        m_ref[:] = m_new
+        l_ref[:] = l_ref[:] * alpha + p.sum(axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + lax.dot_general(
+            p * jnp.swapaxes(v_s, 0, 1), v_blk,
+            dimension_numbers=(((1,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == n_k - 1)
+    def _finish():
+        l_safe = jnp.maximum(l_ref[:], 1e-30)
+        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+
+
 def _kv_index_map(block_k):
     """Clamp the chunk index to the row's last OCCUPIED chunk: programs
     past the occupancy re-reference the chunk already resident, so no DMA
@@ -352,6 +539,83 @@ def _flash_decode_quant(q, k, k_scale, v, v_scale, kv_len, *, block_k,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         interpret=interpret,
     )(kv_len, q, k, k_scale, v, v_scale)
+
+
+def _paged_kv_index_map(block_k):
+    """The block-table gather: logical block j of row b DMAs POOL block
+    ``tbl_ref[b, j]``. Blocks entirely past the row's occupancy re-
+    reference the last occupied block (their compute is skipped by
+    ``pl.when``) — the same clamp discipline as ``_kv_index_map``, with
+    the table lookup composed on top. Both the lengths and the table
+    ride the scalar-prefetch channel, so the physical id is available
+    to the DMA before the kernel body runs."""
+
+    def index_map(b_, j, len_ref, tbl_ref):
+        last = jnp.maximum((len_ref[b_] - 1) // block_k, 0)
+        jj = jnp.minimum(j, last)
+        return (tbl_ref[b_, jj], 0, 0, 0)
+
+    return index_map
+
+
+def _paged_scale_index_map(block_k):
+    """The ``[N, bs, H]`` scale pools' twin of ``_paged_kv_index_map``."""
+
+    def index_map(b_, j, len_ref, tbl_ref):
+        last = jnp.maximum((len_ref[b_] - 1) // block_k, 0)
+        jj = jnp.minimum(j, last)
+        return (tbl_ref[b_, jj], 0, 0)
+
+    return index_map
+
+
+def _flash_paged_decode(q, k_pool, v_pool, kv_len, tables, *, interpret,
+                        k_scale=None, v_scale=None):
+    """q ``[B, H, D]``, pools ``[N, bs, H, D]`` (+ optional ``[N, bs, H]``
+    fp32 scales), tables ``[B, M]`` int32 -> ``[B, H, D]``. Grid is
+    (rows, logical blocks); block_k == the pool's block size."""
+    b, h, d = q.shape
+    _, bs, _, _ = k_pool.shape
+    n_k = tables.shape[1]
+    q_spec = pl.BlockSpec((1, h, d), lambda b_, j, *_refs: (b_, 0, 0))
+    kv_spec = pl.BlockSpec((1, bs, h, d), _paged_kv_index_map(bs))
+    scratch = [
+        pltpu.VMEM((h, 1), jnp.float32),  # running max
+        pltpu.VMEM((h, 1), jnp.float32),  # running denom
+        pltpu.VMEM((h, d), jnp.float32),  # output accumulator
+    ]
+    if k_scale is None:
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, n_k),
+            in_specs=[q_spec, kv_spec, kv_spec],
+            out_specs=q_spec,
+            scratch_shapes=scratch,
+        )
+        return pl.pallas_call(
+            functools.partial(
+                _paged_decode_kernel, block_k=bs, scale=1.0 / np.sqrt(d)
+            ),
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+            interpret=interpret,
+        )(kv_len, tables, q, k_pool, v_pool)
+    sc_spec = pl.BlockSpec((1, bs, h), _paged_scale_index_map(bs))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, n_k),
+        in_specs=[q_spec, kv_spec, sc_spec, kv_spec, sc_spec],
+        out_specs=q_spec,
+        scratch_shapes=scratch,
+    )
+    return pl.pallas_call(
+        functools.partial(
+            _paged_decode_kernel_quant, block_k=bs, scale=1.0 / np.sqrt(d)
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(kv_len, tables, q, k_pool, k_scale, v_pool, v_scale)
 
 
 # ------------------------------------------------------------------ router
@@ -491,3 +755,133 @@ def decode_attention(
         out_specs=q_spec,
     )
     return fn(q, k, v, kv_len, k_scale, v_scale)
+
+
+def _local_paged_decode(q, k_pool, v_pool, kv_len, tables, *, impl,
+                        interpret, k_scale=None, v_scale=None):
+    """Paged decode attention on LOCAL (already per-shard) arrays; the
+    paged twin of ``_local_decode`` with the same impl routing and
+    fallback contract."""
+    quant = k_scale is not None
+
+    def dense():
+        return dense_paged_decode_attention(
+            q, k_pool, v_pool, kv_len, tables, k_scale, v_scale
+        )
+
+    if impl == "dense":
+        return dense()
+    if impl != "flash":
+        raise KeyError(
+            f"unknown decode_attention impl {impl!r} (dense | flash)"
+        )
+    if interpret is None:
+        interpret = FORCE_INTERPRET
+    bs, d = k_pool.shape[1], q.shape[-1]
+    # The pool block IS the kernel chunk: it must be a tileable size on
+    # its own (the contiguous kernel gets to pick a divisor; a paged
+    # kernel cannot re-chunk across physical blocks).
+    tileable = bs >= 8 and (bs & (bs - 1)) == 0 and d % 32 == 0
+    if not tileable:
+        if jax.default_backend() == "tpu":
+            _warn_fallback(
+                "paged flash-decode falling back to dense: block geometry "
+                f"(bs={bs}, head_dim={d}) is not tileable (need a "
+                "power-of-two block size >= 8 and head_dim % 32 == 0)"
+            )
+        return dense()
+    if interpret is None:
+        if jax.default_backend() != "tpu":
+            return dense()
+        interpret = False
+    lens = jnp.maximum(kv_len.astype(jnp.int32), 1)
+    tbl = tables.astype(jnp.int32)
+    if quant:
+        return _flash_paged_decode(
+            q, k_pool, v_pool, lens, tbl, interpret=interpret,
+            k_scale=k_scale.astype(jnp.float32),
+            v_scale=v_scale.astype(jnp.float32),
+        )
+    return _flash_paged_decode(
+        q, k_pool, v_pool, lens, tbl, interpret=interpret
+    )
+
+
+def paged_decode_attention(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    kv_len: jax.Array,
+    block_tables: jax.Array,
+    *,
+    k_scale: jax.Array | None = None,
+    v_scale: jax.Array | None = None,
+    impl: str = "flash",
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Single-token decode attention over a PAGED (block-pool) KV cache —
+    the paged sibling of ``decode_attention`` and the one entry point the
+    block-table decode path (models/gpt.py paged branch, serving engine)
+    routes through.
+
+    q ``[B, H, D]``; pools ``[N, bs, H, D]`` (block-major storage — the
+    layout serving/engine.py grafts prefilled blocks into); ``kv_len
+    [B]`` int32 logical occupancy; ``block_tables [B, M]`` int32 mapping
+    logical block j of row b to a physical pool block. With
+    ``k_scale``/``v_scale`` (``[N, bs, H]``, both or neither) the pool
+    is quantized and every branch dequantizes per block.
+
+    Sharding: the pool carries NO batch axis — blocks are shared across
+    rows (that is the whole point), so under a live ``model`` axis the
+    pool shards over HEADS only (``P(None, None, 'model', None)``, the
+    paged analog of the ``_constrain_kv_cache`` layout) and is
+    replicated over the batch axes, while q / lengths / tables shard
+    over batch when divisible. Each shard then attends its local heads
+    of its local rows against its full local-head pool — zero
+    collectives here, same as the contiguous path.
+    """
+    from frl_distributed_ml_scaffold_tpu.dist.mesh import (
+        BATCH_AXES,
+        current_mesh_env,
+        shard_map_compat,
+    )
+
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError(
+            "k_scale and v_scale must be passed together (a quantized "
+            "pool quantizes both of its halves)"
+        )
+    env = current_mesh_env()
+    m = env.axis_size("model") if env is not None else 1
+    h = q.shape[1]
+    if env is None or m <= 1 or h % m != 0:
+        return _local_paged_decode(
+            q, k_pool, v_pool, kv_len, block_tables, impl=impl,
+            interpret=interpret, k_scale=k_scale, v_scale=v_scale,
+        )
+    batch = BATCH_AXES if q.shape[0] % env.batch_axis_size == 0 else None
+    q_spec = P(batch, "model", None)
+    pool_spec = P(None, None, "model", None)
+    tbl_spec = P(batch, None)
+    if k_scale is None:
+        fn = shard_map_compat(
+            functools.partial(
+                _local_paged_decode, impl=impl, interpret=interpret
+            ),
+            mesh=env.mesh,
+            in_specs=(q_spec, pool_spec, pool_spec, P(batch), tbl_spec),
+            out_specs=q_spec,
+        )
+        return fn(q, k_pool, v_pool, kv_len, block_tables)
+    sc_spec = P(None, None, "model")
+    fn = shard_map_compat(
+        lambda q_, k_, v_, l_, t_, ks_, vs_: _local_paged_decode(
+            q_, k_, v_, l_, t_, impl=impl, interpret=interpret,
+            k_scale=ks_, v_scale=vs_,
+        ),
+        mesh=env.mesh,
+        in_specs=(q_spec, pool_spec, pool_spec, P(batch), tbl_spec,
+                  sc_spec, sc_spec),
+        out_specs=q_spec,
+    )
+    return fn(q, k_pool, v_pool, kv_len, block_tables, k_scale, v_scale)

@@ -24,6 +24,25 @@ of any length mix freely inside those shapes:
   requests therefore never pay full-context cache traffic — and the
   decode kernel additionally reads only each row's occupied prefix within
   the bucket.
+- **Paged cache** (``kv_block_size > 0``, ISSUE 10): the bucketed
+  per-slot cache is replaced by a fixed POOL of fixed-size KV blocks
+  plus per-slot block tables (ops/decode_attention.py paged kernel; the
+  tables ride the scalar-prefetch channel next to the per-row lengths).
+  Growth becomes appending one block to a table — no cache clone, no
+  bucket ladder, ONE compiled decode shape — and admission is priced in
+  pool headroom: a request reserves its worst-case block count up front,
+  so mid-decode appends can never fail, and a full pool makes the queue
+  head WAIT (backpressure that composes with ``max_queue_depth``'s shed
+  bound: pool exhaustion -> queue growth -> typed sheds). Prefill stays
+  contiguous; the graft scatters exactly the blocks that change owner
+  into the pool (the arXiv 2112.01075 gather-at-the-boundary
+  discipline). Refcounted SHARED-PREFIX caching rides the same
+  allocator: a prompt whose leading full blocks match a cached chain
+  reuses those physical blocks (prefill runs only on the suffix, seeded
+  with the shared prefix gathered block-wise) with copy-on-write at the
+  first divergent/partial block — a common system prompt prefills
+  exactly once, and prefill work scales with UNIQUE prefixes, not
+  requests.
 
 Everything here is host logic around jitted pure functions; under a live
 mesh (captured at construction) the same loop serves model-sharded caches
@@ -45,14 +64,18 @@ import numpy as np
 from frl_distributed_ml_scaffold_tpu import faults
 from frl_distributed_ml_scaffold_tpu.config.schema import ServingConfig
 from frl_distributed_ml_scaffold_tpu.models.generation import (
+    POOL_LEAF_OF,
+    SLOT_LEAF_OF,
     _decode_step,
     _plain_stack,
     _prefill,
     _sample,
+    blocks_for_tokens,
     cache_batch_axis,
     cache_bytes_per_slot,
     cache_capacity_axis,
     next_cache_bucket,
+    pool_block_bytes,
 )
 from frl_distributed_ml_scaffold_tpu.telemetry import (
     Histogram,
@@ -119,6 +142,13 @@ class Completion:
     ttft_s: float = 0.0
     tpot_p50_s: float = 0.0
     tpot_p99_s: float = 0.0
+    # Shared-prefix accounting (ISSUE 10), PER REQUEST — the paged
+    # engine's prefix win measured where SLOs live, not just as an
+    # aggregate gauge: did this request's prompt reuse cached prefix
+    # blocks, and how many prompt tokens were never prefilled because
+    # of it (serve_bench aggregates these into its SLO columns).
+    prefix_cache_hit: bool = False
+    prefill_tokens_saved: int = 0
 
     @property
     def ok(self) -> bool:
@@ -174,6 +204,9 @@ class ServingEngine:
         serving: ServingConfig | None = None,
         max_queue_depth: int = 0,
         default_deadline_s: float = 0.0,
+        kv_block_size: int = 0,
+        kv_pool_blocks: int = 0,
+        prefix_cache: bool | None = None,
         telemetry: MetricsRegistry | None = None,
         tracer: Tracer | None = None,
         stall_timeout_s: float = 0.0,
@@ -200,17 +233,72 @@ class ServingEngine:
         # — THE config-driven path; the scalar kwargs remain for callers
         # without a config. Passing both is a caller bug, refused.
         if serving is not None:
-            if max_queue_depth or default_deadline_s:
+            if (max_queue_depth or default_deadline_s or kv_block_size
+                    or kv_pool_blocks or prefix_cache is not None):
                 raise ValueError(
                     "pass either serving=ServingConfig(...) or the "
-                    "max_queue_depth/default_deadline_s scalars, not both"
+                    "max_queue_depth/default_deadline_s/kv_block_size/"
+                    "kv_pool_blocks/prefix_cache scalars, not both"
                 )
             max_queue_depth = serving.max_queue_depth
             default_deadline_s = serving.default_deadline_s
+            kv_block_size = serving.kv_block_size
+            kv_pool_blocks = serving.kv_pool_blocks
+            prefix_cache = serving.prefix_cache
         if max_queue_depth < 0:
             raise ValueError(f"max_queue_depth={max_queue_depth} < 0")
         self.max_queue_depth = int(max_queue_depth)
         self.default_deadline_s = float(default_deadline_s)
+        # Paged-cache knobs (ISSUE 10). Block sizes are powers of two so
+        # every prompt bucket is a whole number of blocks (the graft's
+        # reshape-to-blocks relies on it) and the paged kernel's chunk is
+        # tileable.
+        self.paged = kv_block_size > 0
+        if self.paged:
+            bs = int(kv_block_size)
+            if bs & (bs - 1) or bs > self.seq_len:
+                raise ValueError(
+                    f"kv_block_size={bs} must be a power of two "
+                    f"<= seq_len={self.seq_len}"
+                )
+            self.block_size = bs
+            self.table_blocks = blocks_for_tokens(self.seq_len, bs)
+            if kv_pool_blocks == 0:
+                # Auto: the never-blocks-admission worst case (+1 trash).
+                kv_pool_blocks = self.num_slots * self.table_blocks + 1
+            if kv_pool_blocks < 2:
+                raise ValueError(
+                    f"kv_pool_blocks={kv_pool_blocks} < 2: block 0 is the "
+                    "reserved trash block, so a usable pool needs >= 2"
+                )
+            self.pool_blocks = int(kv_pool_blocks)
+            # Prompt buckets must stay whole numbers of blocks.
+            self.min_bucket = max(self.min_bucket, bs)
+            self.prefix_cache_enabled = (
+                True if prefix_cache is None else bool(prefix_cache)
+            )
+            # Allocator state: block 0 is TRASH (retired slots' tables
+            # point at it, so the shared decode program's writes for
+            # inactive rows land somewhere harmless instead of a freed —
+            # possibly reallocated — block).
+            self._free: list[int] = list(range(self.pool_blocks - 1, 0, -1))
+            self._ref = np.zeros(self.pool_blocks, np.int64)
+            self._reserved_future = 0
+            self._slot_blocks: list[list[int]] = [
+                [] for _ in range(self.num_slots)
+            ]
+            self._slot_future = np.zeros(self.num_slots, np.int64)
+            self._slot_prefix_hit = np.zeros(self.num_slots, bool)
+            self._slot_tokens_saved = np.zeros(self.num_slots, np.int64)
+            self._tables = np.zeros(
+                (self.num_slots, self.table_blocks), np.int32
+            )
+            self._tables_dirty = True
+            # prompt-prefix bytes -> tuple of physical block ids, LRU
+            # order (move_to_end on hit, popitem(last=False) on evict).
+            self._prefix_cache: collections.OrderedDict[
+                bytes, tuple[int, ...]
+            ] = collections.OrderedDict()
 
         # The mesh is captured ONCE: every jitted program traces under it,
         # so replicated and sharded engines never share a trace.
@@ -241,6 +329,14 @@ class ServingEngine:
         self._decode_jit: dict[int, Any] = {}
         self._graft_jit: dict[tuple[int, int], Any] = {}
         self._grow_jit: dict[tuple[int, int], Any] = {}
+        # Paged-mode programs: ONE decode shape (the pool never grows),
+        # seeded prefills keyed on (suffix bucket, cache bucket), prefix
+        # seeds keyed on (cache bucket, shared blocks), block grafts
+        # keyed on (cache bucket, private blocks written).
+        self._paged_decode_jit: Any = None
+        self._prefill_seeded_jit: dict[tuple[int, int], Any] = {}
+        self._seed_jit: dict[tuple[int, int], Any] = {}
+        self._paged_graft_jit: dict[tuple[int, int], Any] = {}
         # Observability: how often each compiled-shape class actually ran.
         self.stats = collections.Counter()
         # Telemetry (ISSUE 7): every metric is registered up front so both
@@ -323,6 +419,30 @@ class ServingEngine:
             "serve_grow_failures_total",
             help="cache bucket growths that failed (degraded, not fatal)",
         )
+        # Paged-cache + shared-prefix observability (ISSUE 10). Always
+        # registered (the full-catalog contract): 0 on a bucketed engine.
+        self._m_pool_util = t.gauge(
+            "serve_pool_utilization",
+            help="allocated KV pool blocks / usable pool blocks "
+            "(trash block excluded; 0 on a bucketed engine)",
+        )
+        self._m_block_appends = t.counter(
+            "serve_block_append_total",
+            help="mid-decode KV blocks appended to slot tables "
+            "(the paged engine's 'grow': one block, never a cache clone)",
+        )
+        self._m_prefix_hits = t.counter(
+            "serve_prefix_hits_total",
+            help="admissions that reused cached prefix blocks",
+        )
+        self._m_prefix_saved = t.counter(
+            "serve_prefix_tokens_saved_total",
+            help="prompt tokens never prefilled thanks to prefix reuse",
+        )
+        self._m_prefix_hit_rate = t.gauge(
+            "serve_prefix_hit_rate",
+            help="prefix hits / admissions since engine start",
+        )
         self.watchdog = StallWatchdog(
             stall_timeout_s,
             name="serve",
@@ -378,6 +498,14 @@ class ServingEngine:
                 f"prompt ({prompt.size}) + max_new_tokens ({max_new_tokens}) "
                 f"exceeds the model context ({self.seq_len})"
             )
+        if self.paged:
+            _, total = self._request_blocks(int(prompt.size), max_new_tokens)
+            if total > self.pool_blocks - 1:
+                raise ValueError(
+                    f"request needs {total} KV blocks but the pool holds "
+                    f"{self.pool_blocks - 1} usable — it could never admit "
+                    "(raise serving.kv_pool_blocks or shrink the request)"
+                )
         rid = self._next_id if request_id is None else request_id
         if rid in self._issued_ids:
             raise ValueError(
@@ -459,6 +587,17 @@ class ServingEngine:
             raise RuntimeError("reset_cache with active slots in flight")
         self.cache = None
         self.bucket = 0
+        if self.paged:
+            self._free = list(range(self.pool_blocks - 1, 0, -1))
+            self._ref[:] = 0
+            self._reserved_future = 0
+            self._slot_blocks = [[] for _ in range(self.num_slots)]
+            self._slot_future[:] = 0
+            self._slot_prefix_hit[:] = False
+            self._slot_tokens_saved[:] = 0
+            self._tables[:] = 0
+            self._tables_dirty = True
+            self._prefix_cache.clear()
         self.stats.clear()
         # The warm pass's observations include compile time — drop them
         # so the measured pass's histograms report serving, not XLA.
@@ -472,9 +611,22 @@ class ServingEngine:
         per-slot bookkeeping are included (the accounting the bucket HBM
         estimates and serve_bench's bytes-per-slot column must agree
         with; pinned against ``generation.estimate_cache_bytes_per_slot``
-        in tests/test_serving.py). 0 before the first admission."""
+        in tests/test_serving.py). 0 before the first admission.
+
+        Paged mode: the cache is a shared pool, so "per slot" is the
+        PROVISIONED share — total cache-tree bytes (pool + tables +
+        bookkeeping) / num_slots. The per-REQUEST cost paged admission
+        actually prices is ``block_bytes()`` x blocks reserved, which is
+        what lets a deliberately small pool host more slots than the
+        bucketed accounting would (serve_bench's paged capacity column)."""
         if self.cache is None:
             return 0
+        if self.paged:
+            total = sum(
+                int(np.prod(leaf.shape)) * jnp.dtype(leaf.dtype).itemsize
+                for leaf in jax.tree.leaves(self.cache)
+            )
+            return total // self.num_slots
         return cache_bytes_per_slot(self.cache, self.num_slots)
 
     def close(self) -> None:
@@ -594,6 +746,310 @@ class ServingEngine:
             self._grow_jit[(s_old, s_new)] = jax.jit(fn)
         return self._grow_jit[(s_old, s_new)]
 
+    # ------------------------------------------------------- paged programs
+
+    def _paged_model(self):
+        return self.model.clone(
+            kv_block_size=self.block_size, kv_pool_blocks=self.pool_blocks
+        )
+
+    def _init_paged_cache(self) -> None:
+        """Zero pool + tables + bookkeeping, shaped by the paged model's
+        own cache structure (eval_shape — nothing runs), so the engine
+        never hardcodes the cache tree. All-zero tables point every row
+        at the trash block 0."""
+        m = self._paged_model()
+        tok = jax.ShapeDtypeStruct((self.num_slots, 1), jnp.int32)
+        shapes = jax.eval_shape(
+            lambda p, t: m.apply(
+                {"params": p}, t, decode=True, mutable=["cache"]
+            )[1]["cache"],
+            self.params, tok,
+        )
+        with self._trace_ctx():
+            self.cache = jax.jit(
+                lambda: jax.tree.map(
+                    lambda s: jnp.zeros(s.shape, s.dtype), shapes
+                )
+            )()
+        self._tables_dirty = True
+
+    def _paged_decode_fn(self):
+        """THE paged decode program — one compiled shape for the whole
+        engine lifetime (the pool never grows; per-row capacity is the
+        block table, which is data, not shape)."""
+        if self._paged_decode_jit is None:
+            m = self._paged_model()
+            kw = dict(self._sample_kw)
+
+            def fn(params, cache, tok, rng):
+                logits, cache = _decode_step(m, params, cache, tok)
+                return _sample(logits, rng, **kw), cache
+
+            # Donate the cache (pool included) — the same two-caches-live
+            # audit fix as _decode_fn, now sized at the POOL.
+            self._paged_decode_jit = jax.jit(fn, donate_argnums=(1,))
+        return self._paged_decode_jit
+
+    def _prefill_seeded_fn(self, s_p: int, s_c: int):
+        """Suffix prefill for shared-prefix admissions: the prompt SUFFIX
+        (bucketed to ``s_p``) prefills against an initial slot cache of
+        capacity ``s_c`` whose leading positions hold the shared prefix's
+        K/V and whose indices start at the prefix length — the attention
+        math is identical to a full-prompt prefill minus the prefix
+        tokens' projection/score work (that is the prefill-once win)."""
+        if (s_p, s_c) not in self._prefill_seeded_jit:
+            m = self._model_at(s_c)
+            kw = dict(self._sample_kw)
+
+            def fn(params, prompt, lengths, rng, cache0):
+                logits, cache = _prefill(
+                    m, params, prompt, lengths, cache=cache0
+                )
+                return _sample(logits, rng, **kw), cache
+
+            self._prefill_seeded_jit[(s_p, s_c)] = jax.jit(
+                fn, donate_argnums=(4,)
+            )
+        return self._prefill_seeded_jit[(s_p, s_c)]
+
+    def _seed_fn(self, s_c: int, m: int):
+        """Gather ``m`` shared pool blocks into the leading positions of
+        a fresh slot cache at capacity ``s_c`` (indices seeded to
+        ``m*block_size``): exactly the blocks that change hands move —
+        never a logical-cache materialization (gather at the boundary)."""
+        if (s_c, m) not in self._seed_jit:
+            bs = self.block_size
+
+            def fn(cache, ids):
+                from flax.traverse_util import flatten_dict, unflatten_dict
+
+                flat = flatten_dict(cache)
+                out = {}
+                for kp, leaf in flat.items():
+                    name = kp[-1]
+                    if name in SLOT_LEAF_OF:
+                        # [L, N, bs, ...] -> [L, m, bs, ...] gather ->
+                        # [L, 1, m*bs, ...] contiguous prefix, padded to
+                        # the slot-cache capacity.
+                        g = jnp.take(leaf, ids, axis=1)
+                        contig = g.reshape(
+                            (leaf.shape[0], 1, m * bs) + leaf.shape[3:]
+                        )
+                        pad = [(0, 0)] * contig.ndim
+                        pad[2] = (0, s_c - m * bs)
+                        out[kp[:-1] + (SLOT_LEAF_OF[name],)] = jnp.pad(
+                            contig, pad
+                        )
+                    elif name == "cache_index":
+                        out[kp] = jnp.full(
+                            (leaf.shape[0], 1), m * bs, jnp.int32
+                        )
+                    elif name == "pos_index":
+                        out[kp] = jnp.full((1,), m * bs, jnp.int32)
+                    # block_tables: slot caches carry none.
+                return unflatten_dict(out)
+
+            self._seed_jit[(s_c, m)] = jax.jit(fn)
+        return self._seed_jit[(s_c, m)]
+
+    def _paged_graft_fn(self, s_c: int, n_priv: int):
+        """Scatter one prefilled slot cache into the pool: the ``n_priv``
+        private blocks starting at logical block ``m0`` are written to
+        the physical ids in ``blk_ids``, and the slot's cache_index /
+        pos_index rows are set — shared prefix blocks are already in the
+        pool and are NOT touched (move only the blocks that change
+        owner). The engine cache (pool) is donated like every program
+        that rebinds it; appends and growth never clone it."""
+        if (s_c, n_priv) not in self._paged_graft_jit:
+            bs = self.block_size
+            n_blk = s_c // bs
+
+            def fn(cache, slot_cache, blk_ids, m0, slot):
+                from flax.traverse_util import flatten_dict, unflatten_dict
+
+                flat = flatten_dict(cache)
+                out = dict(flat)
+                sflat = flatten_dict(slot_cache)
+                for kp, leaf in sflat.items():
+                    name = kp[-1]
+                    if name in POOL_LEAF_OF:
+                        pool_path = kp[:-1] + (POOL_LEAF_OF[name],)
+                        pool = out[pool_path]
+                        chunks = leaf[:, 0].reshape(
+                            (leaf.shape[0], n_blk, bs) + leaf.shape[3:]
+                        )
+                        sl = jax.lax.dynamic_slice_in_dim(
+                            chunks, m0, n_priv, axis=1
+                        )
+                        out[pool_path] = pool.at[:, blk_ids].set(
+                            sl.astype(pool.dtype)
+                        )
+                    elif name == "cache_index":
+                        out[kp] = out[kp].at[:, slot].set(leaf[:, 0])
+                    elif name == "pos_index":
+                        out[kp] = out[kp].at[slot].set(leaf[0])
+                return unflatten_dict(out)
+
+            self._paged_graft_jit[(s_c, n_priv)] = jax.jit(
+                fn, donate_argnums=(0,)
+            )
+        return self._paged_graft_jit[(s_c, n_priv)]
+
+    # ------------------------------------------------- paged block allocator
+
+    def _deref(self, bid: int) -> None:
+        self._ref[bid] -= 1
+        if self._ref[bid] == 0:
+            self._free.append(bid)
+
+    def _evict_one(self) -> bool:
+        """Drop the least-recently-used prefix-cache entry; its blocks
+        free once no slot (and no other entry) references them."""
+        if not self._prefix_cache:
+            return False
+        _, ids = self._prefix_cache.popitem(last=False)
+        for bid in ids:
+            self._deref(bid)
+        self.stats["prefix_evictions"] += 1
+        return True
+
+    def _match_prefix(self, prompt: np.ndarray) -> tuple[int, tuple[int, ...]]:
+        """Longest cached full-block chain matching the prompt's leading
+        tokens, capped so at least one token remains to prefill (the
+        suffix prefill produces the first sampled token's logits).
+        Sharing is FULL-block granular: the block containing the first
+        divergent (or final partial) position is never shared — it is
+        re-derived privately at admission, the copy-on-write that keeps
+        shared blocks immutable."""
+        if not self.prefix_cache_enabled:
+            return 0, ()
+        bs = self.block_size
+        n_full = (int(prompt.size) - 1) // bs
+        # Keys are the EXACT token bytes per chain length (O(L^2/bs) key
+        # bytes per unique prompt) — deliberately not per-block chain
+        # hashes: a hash collision here would serve one tenant's KV to
+        # another, and serving prompts are bounded by seq_len.
+        for i in range(n_full, 0, -1):
+            key = prompt[: i * bs].tobytes()
+            entry = self._prefix_cache.get(key)
+            if entry is not None:
+                self._prefix_cache.move_to_end(key)
+                return i, entry
+        return 0, ()
+
+    def _register_prefix(self, prompt: np.ndarray, blocks: list[int]) -> None:
+        """Publish every full-block chain of this prompt (each entry
+        holds one reference per block, released at eviction)."""
+        if not self.prefix_cache_enabled:
+            return
+        bs = self.block_size
+        for i in range(1, int(prompt.size) // bs + 1):
+            key = prompt[: i * bs].tobytes()
+            if key in self._prefix_cache:
+                self._prefix_cache.move_to_end(key)
+                continue
+            ids = tuple(blocks[:i])
+            for bid in ids:
+                self._ref[bid] += 1
+            self._prefix_cache[key] = ids
+
+    def _request_blocks(self, l: int, n_new: int) -> tuple[int, int]:
+        """(blocks allocated at admission, worst-case total): positions
+        cached over the request's life are [0, l + n_new - 1) (the final
+        sampled token is never written back), and admission allocates
+        through position ``l`` — the first decode write — so appends are
+        the only growth left."""
+        bs = self.block_size
+        highest = l + n_new - 2 if n_new >= 2 else l - 1
+        total = highest // bs + 1
+        now = min(total, l // bs + 1)
+        return now, total
+
+    def _pool_reserve(self, req: ServeRequest) -> dict | None:
+        """Admission headroom: match the prefix, then reserve every block
+        the request can ever need (private-now + future appends) against
+        the free list, evicting idle prefix entries LRU if required.
+        ``None`` = the pool cannot host the request yet — the queue head
+        WAITS (retiring slots release blocks; with bounded admission the
+        growing queue sheds new submits, the documented composition)."""
+        l, n_new = int(req.prompt.size), req.max_new_tokens
+        m, shared = self._match_prefix(req.prompt)
+        n_now, n_total = self._request_blocks(l, n_new)
+        need = (n_now - m) + (n_total - n_now)
+        # Shared blocks are pinned FIRST so the eviction loop can never
+        # free the chain we are about to reuse.
+        for bid in shared:
+            self._ref[bid] += 1
+        if len(self._free) - self._reserved_future < need:
+            # Evict ONLY if eviction can actually satisfy the request:
+            # count the blocks the cache could free (ref held exclusively
+            # by cache entries) before touching it — otherwise a
+            # deferred oversized head request would strip the whole
+            # prefix cache every step() while gaining nothing, silently
+            # defeating prefill-once under exactly the load it targets.
+            cache_refs = collections.Counter(
+                bid for ids in self._prefix_cache.values() for bid in ids
+            )
+            freeable = sum(
+                1 for bid, n in cache_refs.items() if self._ref[bid] == n
+            )
+            if (
+                len(self._free) + freeable - self._reserved_future < need
+            ):
+                for bid in shared:
+                    self._deref(bid)
+                return None
+            while (
+                len(self._free) - self._reserved_future < need
+                and self._evict_one()
+            ):
+                pass
+        priv = [self._free.pop() for _ in range(n_now - m)]
+        for bid in priv:
+            self._ref[bid] += 1
+        self._reserved_future += n_total - n_now
+        return {
+            "m": m,
+            "shared": list(shared),
+            "priv": priv,
+            "future": n_total - n_now,
+        }
+
+    def _pool_release(self, res: dict) -> None:
+        """Roll back a reservation whose admission failed (quarantine).
+        Private ids were popped off the free list; _deref re-appends
+        them at refcount zero, so the list is whole again."""
+        for bid in res["priv"] + res["shared"]:
+            self._deref(bid)
+        self._reserved_future -= res["future"]
+
+    def _note_pool_peak(self) -> None:
+        """High-watermark of pool DEMAND — blocks held by slots plus
+        worst-case reservations, with prefix sharing counted once. This
+        is what serve_bench's paged capacity column prices a concurrent
+        slot at: blocks held ONLY by the prefix cache are deliberately
+        excluded (they are evicted on demand when admission needs the
+        room, so they are a cache, not a capacity cost)."""
+        held = {bid for blks in self._slot_blocks for bid in blks}
+        demand = len(held) + self._reserved_future
+        if demand > self.stats["pool_peak_blocks"]:
+            self.stats["pool_peak_blocks"] = demand
+
+    def pool_utilization(self) -> float:
+        """Allocated blocks / usable blocks (trash excluded)."""
+        if not self.paged:
+            return 0.0
+        usable = self.pool_blocks - 1
+        return (usable - len(self._free)) / max(usable, 1)
+
+    def block_bytes(self) -> int:
+        """HBM bytes of one pool block (all layers, scales included) —
+        the unit paged admission is priced in. 0 before the pool exists."""
+        if not self.paged or self.cache is None:
+            return 0
+        return pool_block_bytes(self.cache)
+
     # --------------------------------------------------------- scheduling
 
     def _bucket_for(self, needed: int) -> int:
@@ -661,18 +1117,35 @@ class ServingEngine:
             # actually admits: expired and poison requests resolve typed
             # and must not burn the slot's admission for this step.
             while self._queue:
-                req = self._queue.popleft()
+                req = self._queue[0]
                 if self._expired(req):
                     # Past deadline while still queued: shedding now is
                     # strictly better than prefilling work whose answer
                     # the caller has already abandoned.
+                    self._queue.popleft()
                     self._m_deadline.inc()
                     self._complete_unadmitted(req, "deadline")
                     continue
-                if self._try_admit(slot, req):
+                res = None
+                if self.paged:
+                    res = self._pool_reserve(req)
+                    if res is None:
+                        # Pool headroom exhausted: the head request
+                        # WAITS (FIFO — no smaller request jumps it) for
+                        # retiring slots to release blocks. Backpressure,
+                        # not failure: with max_queue_depth set, the
+                        # queue growing past the bound sheds new submits
+                        # typed, which is the documented pool-exhaustion
+                        # x bounded-admission composition.
+                        self.stats["admission_deferred"] += 1
+                        return
+                self._queue.popleft()
+                if self._try_admit(slot, req, res):
                     break
 
-    def _try_admit(self, slot: int, req: ServeRequest) -> bool:
+    def _try_admit(
+        self, slot: int, req: ServeRequest, res: dict | None = None
+    ) -> bool:
         """Prefill + graft ``req`` into ``slot``. A failure ANYWHERE in
         the request's own admission work (poison prompt crashing the
         prefill, cache growth failing) quarantines THIS request with a
@@ -681,9 +1154,13 @@ class ServingEngine:
         cache is only rebound to outputs of successful programs, so a
         failed admission cannot corrupt live slots."""
         l = int(req.prompt.size)
-        s_p = self._bucket_for(l)
+        bs = self.block_size if self.paged else 0
+        m = res["m"] if res is not None else 0
+        l_suf = l - m * bs  # >= 1 by the _match_prefix cap
+        s_p = self._bucket_for(l_suf)
+        s_c = self._bucket_for(l) if self.paged else s_p
         prompt = np.zeros((1, s_p), np.int32)
-        prompt[0, s_p - l :] = req.prompt  # left-pad, right-aligned
+        prompt[0, s_p - l_suf :] = req.prompt[m * bs :]  # left-pad suffix
         prev_rng = self._rng
         self._rng, sub = jax.random.split(self._rng)
         t0 = time.perf_counter()
@@ -696,25 +1173,63 @@ class ServingEngine:
         try:
             faults.maybe_raise("serve.prefill", key=req.id)
             with self._trace_ctx():
-                tok, slot_cache = self._prefill_fn(s_p)(
-                    self.params,
-                    jnp.asarray(prompt),
-                    jnp.asarray([l], jnp.int32),
-                    sub,
-                )
-                if self.cache is None:
-                    self.cache = self._empty_cache(slot_cache, s_p)
-                    self.bucket = s_p
+                if self.paged and self.cache is None:
+                    self._init_paged_cache()
+                if m > 0:
+                    # Shared-prefix admission: seed a slot cache with the
+                    # shared blocks gathered from the pool, prefill only
+                    # the suffix from position m*bs.
+                    cache0 = self._seed_fn(s_c, m)(
+                        self.cache,
+                        jnp.asarray(res["shared"], jnp.int32),
+                    )
+                    tok, slot_cache = self._prefill_seeded_fn(s_p, s_c)(
+                        self.params,
+                        jnp.asarray(prompt),
+                        jnp.asarray([l_suf], jnp.int32),
+                        sub,
+                        cache0,
+                    )
+                else:
+                    tok, slot_cache = self._prefill_fn(s_p)(
+                        self.params,
+                        jnp.asarray(prompt),
+                        jnp.asarray([l], jnp.int32),
+                        sub,
+                    )
                 t_graft = time.perf_counter()
-                self._ensure_bucket(max(s_p, l + 1))
-                self.cache = self._graft_fn(s_p, self.bucket)(
-                    self.cache, slot_cache, jnp.int32(slot)
-                )
+                if self.paged:
+                    # Block graft: write the private prefilled blocks
+                    # (logical m..ceil(l/bs)-1) into their pool homes +
+                    # the slot's index rows — never a cache clone, never
+                    # a shared block.
+                    n_g = blocks_for_tokens(l, bs)
+                    self.cache = self._paged_graft_fn(s_c, n_g - m)(
+                        self.cache,
+                        slot_cache,
+                        jnp.asarray(res["priv"][: n_g - m], jnp.int32),
+                        jnp.int32(m),
+                        jnp.int32(slot),
+                    )
+                    blocks = res["shared"] + res["priv"]
+                    self._tables[slot, :] = 0
+                    self._tables[slot, : len(blocks)] = blocks
+                    self._tables_dirty = True
+                else:
+                    if self.cache is None:
+                        self.cache = self._empty_cache(slot_cache, s_p)
+                        self.bucket = s_p
+                    self._ensure_bucket(max(s_p, l + 1))
+                    self.cache = self._graft_fn(s_p, self.bucket)(
+                        self.cache, slot_cache, jnp.int32(slot)
+                    )
                 self._phase(
                     "graft", t0=t_graft,
                     dur_s=time.perf_counter() - t_graft,
                     trace=req.trace, parent=req.span,
                     slot=slot, bucket=self.bucket,
+                    **({"blocks": n_g - m, "shared": m} if self.paged
+                       else {}),
                 )
             tok = int(jax.device_get(tok)[0])
         except Exception as e:
@@ -726,6 +1241,8 @@ class ServingEngine:
             # give them — chaos token-identity holds for SAMPLED
             # (temperature>0) decode too, not just greedy.
             self._rng = prev_rng
+            if res is not None:
+                self._pool_release(res)
             self._m_quarantined.inc()
             self.stats["quarantined"] += 1
             from frl_distributed_ml_scaffold_tpu.utils.logging import (
@@ -741,6 +1258,8 @@ class ServingEngine:
             return False
         dt = time.perf_counter() - t0
         self.stats[f"prefill_{s_p}"] += 1
+        self.stats["admitted"] += 1
+        self.stats["prefill_tokens"] += l_suf
         # TTFT = submit-to-first-token work this engine performed for
         # the request: prefill + graft + the forced first-token fetch.
         # (Queue wait is visible separately via serve_queue_depth.)
@@ -748,10 +1267,30 @@ class ServingEngine:
         self._m_prefills.inc()
         self._m_grafts.inc()
         self._m_bytes_slot.set(self.bytes_per_slot())
+        if self.paged:
+            self._slot_blocks[slot] = res["shared"] + res["priv"]
+            self._slot_future[slot] = res["future"]
+            self._note_pool_peak()
+            self._slot_prefix_hit[slot] = m > 0
+            self._slot_tokens_saved[slot] = m * bs
+            if m > 0:
+                self.stats["prefix_hits"] += 1
+                self.stats["prefill_tokens_saved"] += m * bs
+                self._m_prefix_hits.inc()
+                self._m_prefix_saved.inc(m * bs)
+            self._m_prefix_hit_rate.set(
+                self.stats["prefix_hits"] / self.stats["admitted"]
+            )
+            self._m_pool_util.set(self.pool_utilization())
+            # Publish this prompt's full-block chains for later
+            # admissions (refcounted by the cache itself).
+            self._register_prefix(req.prompt, self._slot_blocks[slot])
         self._phase(
             "prefill", t0=t0, dur_s=dt, trace=req.trace,
             parent=req.span,
             slot=slot, bucket=s_p, request=req.id,
+            **({"prefix_hit": m > 0, "tokens_saved": m * bs}
+               if self.paged else {}),
         )
         self.watchdog.beat()
 
@@ -793,10 +1332,31 @@ class ServingEngine:
             ttft_s=lat[0] if lat else 0.0,
             tpot_p50_s=tpot[0],
             tpot_p99_s=tpot[1],
+            prefix_cache_hit=(
+                bool(self._slot_prefix_hit[slot]) if self.paged else False
+            ),
+            prefill_tokens_saved=(
+                int(self._slot_tokens_saved[slot]) if self.paged else 0
+            ),
         )
         self._completed.append(comp)
         self._req[slot] = None
         self._active[slot] = False
+        if self.paged:
+            # Release the slot's block references (prefix-cache entries
+            # keep shared chains alive past retirement — that is the
+            # prefill-once cache), drop the unexercised reservation, and
+            # point the table row at the trash block so this row's
+            # writes in the shared decode program can never land in a
+            # freed — possibly reallocated — block.
+            for bid in self._slot_blocks[slot]:
+                self._deref(bid)
+            self._reserved_future -= int(self._slot_future[slot])
+            self._slot_blocks[slot] = []
+            self._slot_future[slot] = 0
+            self._tables[slot, :] = 0
+            self._tables_dirty = True
+            self._m_pool_util.set(self.pool_utilization())
         self.stats["completed"] += 1
         self.stats[f"finish_{reason}"] += 1
         self._m_completed.inc()
@@ -827,40 +1387,100 @@ class ServingEngine:
         if not self._active.any():
             return self._completed
 
-        # Bucket must hold every active row's next write position: an
-        # active row holds cache_index == _len - 1 (prefill sets idx=l
-        # with _len=l+1; both advance together), so this step writes
-        # position _len - 1 and needs capacity exactly _len.
-        try:
-            self._ensure_bucket(int(self._len[self._active].max()))
-        except CacheGrowError as e:
-            # Degrade, don't die: rows that NEED the larger bucket are
-            # retired typed ("error", carrying their tokens so far); rows
-            # still inside the current bucket keep decoding — a capacity
-            # failure at high occupancy costs the big requests, never the
-            # whole batch.
-            from frl_distributed_ml_scaffold_tpu.utils.logging import (
-                get_logger,
-            )
+        if self.paged:
+            # Paged growth: a row crossing a block boundary APPENDS one
+            # reserved block to its table — a host-side int write plus a
+            # table push, never a device-side cache clone. The
+            # reservation made at admission guarantees a free block, so
+            # the only failure left is the injected serve.grow fault
+            # (kept on the same degrade-per-row contract as bucketed
+            # growth: the crossing row retires typed, the batch lives).
+            for slot in np.flatnonzero(self._active):
+                need = (int(self._len[slot]) - 1) // self.block_size + 1
+                while len(self._slot_blocks[slot]) < need:
+                    try:
+                        faults.maybe_raise(
+                            "serve.grow", CacheGrowError,
+                            msg=f"injected block-append failure slot {slot}",
+                        )
+                        bid = self._free.pop()
+                    except Exception as e:
+                        self._m_grow_failures.inc()
+                        self.stats["grow_failures"] += 1
+                        from frl_distributed_ml_scaffold_tpu.utils.logging import (
+                            get_logger,
+                        )
 
-            victims = [
-                s for s in np.flatnonzero(self._active)
-                if self._len[s] > self.bucket
-            ]
-            get_logger().warning(
-                "serving: cache grow failed (%s); retiring %d slot(s) "
-                "needing the larger bucket, %d keep decoding",
-                e, len(victims), int(self._active.sum()) - len(victims),
-            )
-            for s in victims:
-                self._retire(int(s), "error")
+                        get_logger().warning(
+                            "serving: block append failed for slot %d "
+                            "(%s: %s); retiring it, batch keeps decoding",
+                            slot, type(e).__name__, e,
+                        )
+                        self._retire(int(slot), "error")
+                        break
+                    self._reserved_future -= 1
+                    self._slot_future[slot] -= 1
+                    self._ref[bid] += 1
+                    # (No peak sample here: an append converts one
+                    # reservation into one held block — demand is
+                    # unchanged, the admission-time sample covers it.)
+                    self._slot_blocks[slot].append(bid)
+                    self._tables[slot, len(self._slot_blocks[slot]) - 1] = bid
+                    self._tables_dirty = True
+                    self.stats["block_append"] += 1
+                    self._m_block_appends.inc()
+                    self._phase(
+                        "block_append", t0=time.perf_counter(), dur_s=0.0,
+                        trace=self._engine_trace, slot=int(slot), block=bid,
+                    )
+            self._m_pool_util.set(self.pool_utilization())
             if not self._active.any():
                 return self._completed
+            if self._tables_dirty:
+                self.cache = {
+                    **self.cache,
+                    "block_tables": jnp.asarray(self._tables),
+                }
+                self._tables_dirty = False
+        else:
+            # Bucket must hold every active row's next write position: an
+            # active row holds cache_index == _len - 1 (prefill sets idx=l
+            # with _len=l+1; both advance together), so this step writes
+            # position _len - 1 and needs capacity exactly _len.
+            try:
+                self._ensure_bucket(int(self._len[self._active].max()))
+            except CacheGrowError as e:
+                # Degrade, don't die: rows that NEED the larger bucket are
+                # retired typed ("error", carrying their tokens so far);
+                # rows still inside the current bucket keep decoding — a
+                # capacity failure at high occupancy costs the big
+                # requests, never the whole batch.
+                from frl_distributed_ml_scaffold_tpu.utils.logging import (
+                    get_logger,
+                )
+
+                victims = [
+                    s for s in np.flatnonzero(self._active)
+                    if self._len[s] > self.bucket
+                ]
+                get_logger().warning(
+                    "serving: cache grow failed (%s); retiring %d slot(s) "
+                    "needing the larger bucket, %d keep decoding",
+                    e, len(victims), int(self._active.sum()) - len(victims),
+                )
+                for s in victims:
+                    self._retire(int(s), "error")
+                if not self._active.any():
+                    return self._completed
 
         self._rng, sub = jax.random.split(self._rng)
         t0 = time.perf_counter()
+        fn = (
+            self._paged_decode_fn() if self.paged
+            else self._decode_fn(self.bucket)
+        )
         with self._trace_ctx():
-            nxt, self.cache = self._decode_fn(self.bucket)(
+            nxt, self.cache = fn(
                 self.params,
                 self.cache,
                 jnp.asarray(self._last_tok),
@@ -868,7 +1488,9 @@ class ServingEngine:
             )
         nxt = np.asarray(jax.device_get(nxt))
         dt = time.perf_counter() - t0
-        self.stats[f"decode_{self.bucket}"] += 1
+        self.stats[
+            "decode_paged" if self.paged else f"decode_{self.bucket}"
+        ] += 1
         self.stats["decode_steps"] += 1
         self._m_decodes.inc()
         # One engine-lane span per slot-array decode program...

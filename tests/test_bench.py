@@ -97,17 +97,28 @@ def test_bench_table_rows_meet_protocol_schema():
     context: mesh, per-sample FLOPs and MFU (BASELINE.md protocol), plus
     capture provenance — incomplete rows can't back the stale fallback.
 
-    ``status: "queued"`` rows are the one sanctioned exception: they
+    ``status: "queued"`` rows are one sanctioned exception: they
     record an experiment awaiting its relay window (BACKLOG R7-1 style)
     and must carry config/mesh/provenance and a note naming the queued
     A/B — but NO measurement fields, so a placeholder can never be
-    mistaken for (or corroborate) a measured number."""
+    mistaken for (or corroborate) a measured number.
+
+    ``status: "stale"`` rows are the other (ISSUE 10 satellite): the
+    relay-down fallback's re-emission of the last real capture
+    (bench.py ``_emit_stale_or_error`` stamps them since round 13 —
+    through rounds 5–9 the 2256.04 RN50 row was re-emitted as if
+    fresh). A stale row carries real measured numbers, so it must keep
+    the measured fields AND declare its staleness: ``stale_reason``
+    plus ``captured_at`` provenance of the ORIGINAL capture — a stale
+    row with no capture time is a fabrication vector, refused."""
     table = os.path.join(REPO_ROOT, "BENCH_TABLE.jsonl")
     rows = [json.loads(l) for l in open(table).read().splitlines() if l.strip()]
     assert rows, "committed BENCH_TABLE.jsonl is empty"
-    assert any(row.get("status") != "queued" for row in rows), (
-        "BENCH_TABLE.jsonl holds only queued placeholders — the stale "
-        "fallback has nothing to corroborate against"
+    assert any(
+        row.get("status") not in ("queued", "stale") for row in rows
+    ), (
+        "BENCH_TABLE.jsonl holds only queued/stale placeholders — the "
+        "stale fallback has nothing to corroborate against"
     )
     for row in rows:
         ctx = f"row for {row.get('config')}"
@@ -126,6 +137,17 @@ def test_bench_table_rows_meet_protocol_schema():
                 "in source/captured_at)"
             )
             continue
+        if row.get("status") == "stale":
+            assert row.get("stale") is True, (
+                f"stale {ctx} missing the stale flag"
+            )
+            assert row.get("stale_reason"), (
+                f"stale {ctx} does not say WHY it is stale"
+            )
+            assert bench._row_captured_at(row), (
+                f"stale {ctx} has no provenance of the original capture"
+            )
+            continue
         for key in ("config", "samples_per_sec_per_chip", "mesh",
                     "model_flops_per_sample", "mfu"):
             assert key in row, f"{ctx} missing {key}"
@@ -133,6 +155,10 @@ def test_bench_table_rows_meet_protocol_schema():
         assert row["model_flops_per_sample"] > 0, ctx
         assert 0 < row["mfu"] < 1.0, ctx
         assert bench._row_captured_at(row), f"{ctx} has no capture provenance"
+        assert "stale" not in row and "stale_reason" not in row, (
+            f"{ctx} carries stale markers without status:'stale' — "
+            "stamp the status so consumers can filter on it"
+        )
 
 
 def test_stale_fallback_tier1_carries_captured_at(
@@ -155,6 +181,8 @@ def test_stale_fallback_tier1_carries_captured_at(
     out = [l for l in capsys.readouterr().out.splitlines() if l.startswith("{")]
     final = json.loads(out[-1])
     assert final["stale"] is True
+    assert final["status"] == "stale"  # the typed stamp (ISSUE 10)
+    assert final["stale_reason"].startswith("relay down")
     assert final["captured_at"] == "2026-07-30T00:00:00Z"
 
 
@@ -179,6 +207,7 @@ def test_stale_fallback_tier2_parses_captured_at_from_table_row(
     out = [l for l in captured.out.splitlines() if l.startswith("{")]
     final = json.loads(out[-1])
     assert final["stale"] is True
+    assert final["status"] == "stale"  # the typed stamp (ISSUE 10)
     assert final["value"] == 2256.04
     assert final["captured_at"] == "2026-07-30T21:26:00Z"
     assert "unknown time" not in captured.err

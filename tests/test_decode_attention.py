@@ -253,6 +253,183 @@ def test_quant_dense_chunk_is_strictly_smaller_than_bucket():
         )
 
 
+# ------------------------------------------------------------ paged cache
+
+
+def _paged_from_contiguous(k, v, bs, n_blocks, seed=0, scales=None):
+    """Scatter a contiguous [B, S, H, D] cache into pool blocks through a
+    random (non-trivial) block table — the layout serving/engine.py
+    grafts into, built here by hand so the op gates do not depend on the
+    engine."""
+    rng = np.random.default_rng(seed)
+    b, s, h, d = k.shape
+    m_tbl = s // bs
+    assert b * m_tbl <= n_blocks - 1, "pool too small for the fixture"
+    perm = rng.permutation(np.arange(1, n_blocks))[: b * m_tbl]
+    tables = jnp.asarray(perm.reshape(b, m_tbl), jnp.int32)
+    k_pool = jnp.zeros((n_blocks, bs, h, d), k.dtype)
+    v_pool = jnp.zeros((n_blocks, bs, h, d), v.dtype)
+    sc_pools = None
+    if scales is not None:
+        ks, vs = scales
+        ksp = jnp.zeros((n_blocks, bs, h), ks.dtype)
+        vsp = jnp.zeros((n_blocks, bs, h), vs.dtype)
+    for bb in range(b):
+        for j in range(m_tbl):
+            pid = int(tables[bb, j])
+            k_pool = k_pool.at[pid].set(k[bb, j * bs : (j + 1) * bs])
+            v_pool = v_pool.at[pid].set(v[bb, j * bs : (j + 1) * bs])
+            if scales is not None:
+                ksp = ksp.at[pid].set(ks[bb, j * bs : (j + 1) * bs])
+                vsp = vsp.at[pid].set(vs[bb, j * bs : (j + 1) * bs])
+    if scales is not None:
+        sc_pools = (ksp, vsp)
+    return k_pool, v_pool, tables, sc_pools
+
+
+@pytest.mark.fast
+@pytest.mark.parametrize("s", [16, 64, 512], ids=lambda s: f"S{s}")
+def test_paged_decode_matches_contiguous_across_occupancies(s):
+    """The paged column of the kernel grid (ISSUE 10): the streamed
+    paged dense reference tracks the contiguous dense reference to fp32
+    merge tolerance at every occupancy class, and the interpreter-mode
+    paged kernel (block table on the scalar-prefetch channel) matches
+    the streamed reference — same physical blocks, same order, same
+    chunking — to kernel tolerance."""
+    b, h, d, bs = 3, 4, 64, 8
+    for occ in _occupancies(s):
+        q, k, v = _make(b, s, h, d, jnp.float32, seed=occ)
+        lens = jnp.asarray(
+            [occ, max(1, occ // 2), min(s, occ + 3)], jnp.int32
+        )
+        k_pool, v_pool, tables, _ = _paged_from_contiguous(
+            k, v, bs, b * (s // bs) + 7, seed=occ
+        )
+        ref = da.dense_decode_attention(q, k, v, lens)
+        out = da.dense_paged_decode_attention(
+            q, k_pool, v_pool, lens, tables
+        )
+        np.testing.assert_allclose(
+            np.asarray(ref), np.asarray(out), atol=2e-6, rtol=2e-6
+        )
+        kern = da._local_paged_decode(
+            q, k_pool, v_pool, lens, tables, impl="flash", interpret=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(kern), np.asarray(out), atol=2e-6, rtol=2e-6
+        )
+
+
+@pytest.mark.fast
+def test_paged_quant_decode_matches_quant_dense():
+    """Quantized pools: the paged streamed reference == the contiguous
+    chunked quantized reference (same once-quantized values), and the
+    interpreter-mode quantized paged kernel tracks it."""
+    b, s, h, d, bs = 3, 64, 4, 64, 8
+    for occ in (1, 9, 32, 64):
+        q, (k, v), (kq, ks), (vq, vs) = _make_quant(b, s, h, d, seed=occ)
+        lens = jnp.asarray(
+            [occ, max(1, occ // 2), min(s, occ + 3)], jnp.int32
+        )
+        kqp, vqp, tables, (ksp, vsp) = _paged_from_contiguous(
+            kq, vq, bs, b * (s // bs) + 5, seed=occ,
+            scales=(ks.astype(jnp.float32), vs.astype(jnp.float32)),
+        )
+        ref = da.dense_decode_attention_quant(q, kq, vq, lens, ks, vs)
+        out = da.dense_paged_decode_attention(
+            q, kqp, vqp, lens, tables, ksp, vsp
+        )
+        np.testing.assert_allclose(
+            np.asarray(ref), np.asarray(out), atol=3e-6, rtol=3e-6
+        )
+        kern = da._local_paged_decode(
+            q, kqp, vqp, lens, tables, impl="flash", interpret=True,
+            k_scale=ksp, v_scale=vsp,
+        )
+        np.testing.assert_allclose(
+            np.asarray(kern), np.asarray(out), atol=3e-6, rtol=3e-6
+        )
+
+
+@pytest.mark.fast
+def test_paged_decode_ignores_unreferenced_and_dead_blocks():
+    """Isolation, the property block sharing rests on: pool blocks not
+    referenced by a row's table — and referenced blocks past the row's
+    occupancy — must not influence its output (fill both with garbage
+    and compare against the clean pool)."""
+    b, s, h, d, bs = 2, 64, 4, 64, 8
+    q, k, v = _make(b, s, h, d, jnp.float32)
+    lens = jnp.asarray([5, 23], jnp.int32)
+    k_pool, v_pool, tables, _ = _paged_from_contiguous(k, v, bs, 32)
+    clean = da.dense_paged_decode_attention(q, k_pool, v_pool, lens, tables)
+    # Garbage in every block a row's OCCUPIED prefix does not reach:
+    # row 0 occupies 5 tokens (block 0 of its table), row 1 occupies 23
+    # (blocks 0..2) — everything else in the pool is fair game.
+    live = set()
+    for bb in range(b):
+        for j in range((int(lens[bb]) - 1) // bs + 1):
+            live.add(int(tables[bb, j]))
+    dirty_k, dirty_v = k_pool, v_pool
+    for pid in range(32):
+        if pid not in live:
+            dirty_k = dirty_k.at[pid].set(1e6)
+            dirty_v = dirty_v.at[pid].set(-1e6)
+    # Positions past occupancy INSIDE the last live block too.
+    dirty = da.dense_paged_decode_attention(q, dirty_k, dirty_v, lens, tables)
+    np.testing.assert_array_equal(np.asarray(clean), np.asarray(dirty))
+    kern_clean = da._local_paged_decode(
+        q, k_pool, v_pool, lens, tables, impl="flash", interpret=True
+    )
+    kern_dirty = da._local_paged_decode(
+        q, dirty_k, dirty_v, lens, tables, impl="flash", interpret=True
+    )
+    np.testing.assert_array_equal(
+        np.asarray(kern_clean), np.asarray(kern_dirty)
+    )
+
+
+@pytest.mark.fast
+def test_paged_untileable_block_falls_back_to_dense():
+    """Block geometries outside the kernel contract (block < 8, head_dim
+    not sublane-aligned) must take the identical-numerics streamed dense
+    path, not miscompute — the ``_local_decode`` fallback contract."""
+    b, h = 2, 2
+    for bs, d in ((4, 64), (8, 16)):
+        s = 8 * bs
+        q, k, v = _make(b, s, h, d, jnp.float32)
+        lens = jnp.asarray([3, s], jnp.int32)
+        k_pool, v_pool, tables, _ = _paged_from_contiguous(k, v, bs, 32)
+        out = da._local_paged_decode(
+            q, k_pool, v_pool, lens, tables, impl="flash", interpret=True
+        )
+        ref = da.dense_paged_decode_attention(q, k_pool, v_pool, lens, tables)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+@pytest.mark.fast
+def test_paged_dense_fallback_streams_bounded_chunks():
+    """The paged no-cache-clone contract at the op level: the streamed
+    reference never materializes the logical cache view (no intermediate
+    carries the M*bs logical-context dim) at any block size — the same
+    bounded-chunk discipline as the quantized fallback, which is what
+    the graft-lint paged program pin relies on."""
+    b, h, d = 2, 2, 32
+    for bs, m_tbl in ((8, 8), (16, 32)):
+        s = bs * m_tbl
+        n_blocks = 2 * b * m_tbl + 1
+        q = jnp.zeros((b, h, d), jnp.float32)
+        k_pool = jnp.zeros((n_blocks, bs, h, d), jnp.float32)
+        tables = jnp.zeros((b, m_tbl), jnp.int32)
+        lens = jnp.asarray([1, s], jnp.int32)
+        jaxpr = jax.make_jaxpr(
+            lambda *a: da.dense_paged_decode_attention(*a)
+        )(q, k_pool, k_pool, lens, tables)
+        pins.assert_no_dim_materialized(
+            jaxpr, s,
+            f"paged dense fallback materialized the M*bs={s} logical view",
+        )
+
+
 # --------------------------------------------------------- model decode
 
 

@@ -446,6 +446,68 @@ def test_mutation_wholesale_cache_dequantize_trips_materialization(gpt_tiny):
 
 
 @pytest.mark.fast
+def test_paged_decode_step_lint_clean_and_mutations_trip():
+    """ISSUE 10's no-cache-clone gates on the block-table serving
+    program: the shipped paged decode step passes both teeth (no
+    full-seq_len materialization, nothing bigger than one pool leaf —
+    the donated in-place update); the two canonical regressions trip —
+    (a) clone-per-grow: padding the pool one block wider is a
+    bigger-than-pool copy, exactly the bucketed ``_grow_fn`` clone the
+    paged engine exists to delete; (b) gather-the-logical-view:
+    ``pool[tables]`` reshaped contiguous materializes the full logical
+    context the table indirection exists to avoid."""
+    from frl_distributed_ml_scaffold_tpu.analysis.materialization import (
+        oversized_intermediates,
+    )
+    from frl_distributed_ml_scaffold_tpu.analysis.runner import (
+        _max_pool_leaf_bytes,
+        build_paged_decode_step_program,
+        lint_paged_decode_step,
+    )
+
+    # Positive gates, runner-level: the same analyzers the CLI arms for
+    # serving:decode_step_paged[_int8kv].
+    for quant in ("none", "int8"):
+        rep = lint_paged_decode_step(kv_cache_quant=quant)
+        assert rep.ok, [f.message for f in rep.errors()]
+        assert rep.meta["pool_leaf_bytes"] > 0
+
+    model, params, cache, tok, jaxpr = build_paged_decode_step_program()
+    seq_len = model.config.seq_len
+    budget = _max_pool_leaf_bytes(cache)
+    pins.assert_no_dim_materialized(jaxpr, seq_len)
+    pins.assert_max_materialized_bytes(jaxpr, budget)
+
+    # Mutation (a): clone-per-grow — pad the pool one block wider.
+    def clone_per_grow(c):
+        kp = c["blocks"]["attn"]["key_pool"]  # [L, N, bs, H, hd]
+        pad = [(0, 0)] * kp.ndim
+        pad[1] = (0, 1)
+        return jnp.pad(kp, pad)
+
+    grow_jaxpr = jax.make_jaxpr(clone_per_grow)(cache)
+    assert oversized_intermediates(grow_jaxpr, budget), (
+        "a padded-pool clone fits under the pool-leaf budget — the "
+        "no-cache-clone pin has no teeth"
+    )
+    with pytest.raises(AssertionError, match="budget"):
+        pins.assert_max_materialized_bytes(grow_jaxpr, budget)
+
+    # Mutation (b): gather the logical cache view out of the pool.
+    def gather_logical(c):
+        kp = c["blocks"]["attn"]["key_pool"]  # [L, N, bs, H, hd]
+        tbl = c["block_tables"]  # [B, M]
+        g = jnp.take(kp, tbl, axis=1)  # [L, B, M, bs, H, hd]
+        l, _, _, h, hd = kp.shape
+        b, m = tbl.shape
+        return g.reshape(l, b, m * kp.shape[2], h, hd)  # full context
+
+    gather_jaxpr = jax.make_jaxpr(gather_logical)(cache)
+    with pytest.raises(AssertionError, match=str(seq_len)):
+        pins.assert_no_dim_materialized(gather_jaxpr, seq_len)
+
+
+@pytest.mark.fast
 def test_mutation_dropped_donation_is_caught():
     """THE donation mutation gate: the same program jitted with and
     without donate_argnums — the audit passes the donated one and fires

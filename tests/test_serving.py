@@ -439,6 +439,317 @@ def test_sharded_int8_decode_matches_replicated(gpt_int8, mesh_kw):
     np.testing.assert_array_equal(np.asarray(ref), np.asarray(out))
 
 
+# ----------------------------------------------------- paged (block) cache
+
+
+def _paged_vs_generate(model, params, bs, reqs, num_slots=2, **eng_kw):
+    """Serve ``reqs`` [(prompt, n_new)] through a paged engine and assert
+    every completion equals its own solo generate() run."""
+    eng = ServingEngine(
+        model, params, num_slots=num_slots, temperature=0.0,
+        kv_block_size=bs, **eng_kw,
+    )
+    ids = {eng.submit(p, n): (p, n) for p, n in reqs}
+    done = {c.id: c for c in eng.run()}
+    assert sorted(done) == sorted(ids), "not every request completed"
+    for rid, (prompt, n_new) in ids.items():
+        ref = generate(
+            model, params, jnp.asarray(prompt)[None], max_new_tokens=n_new,
+            temperature=0.0,
+        )
+        np.testing.assert_array_equal(
+            done[rid].tokens, np.asarray(ref)[0],
+            err_msg=f"request {rid} diverged from its solo generate()",
+        )
+    return eng, done
+
+
+def test_paged_engine_matches_generate_with_block_append(gpt):
+    """The paged acceptance core: continuous batching over the block
+    pool is token-identical to generate(), INCLUDING a mid-decode block
+    append (growth = one table write, never a cache clone — the stats
+    prove an append actually happened and that no bucket grow ran)."""
+    model, params, _ = gpt
+    rng = np.random.default_rng(3)
+    reqs = [
+        # 3-token prompt + 14 new tokens crosses the first 8-block
+        # boundary mid-decode (alloc covers position 3; appends follow).
+        (np.arange(3, dtype=np.int32), 14),
+        (rng.integers(0, 64, size=9).astype(np.int32), 5),
+        (rng.integers(0, 64, size=2).astype(np.int32), 8),
+    ]
+    eng, done = _paged_vs_generate(model, params, 8, reqs)
+    assert eng.stats["block_append"] > 0, dict(eng.stats)
+    assert eng.stats["decode_paged"] > 0
+    assert not any(k.startswith("grow_") for k in eng.stats), (
+        "paged engine ran a bucket grow — growth must append blocks"
+    )
+    # Every block released at retirement except prefix-cache-held ones;
+    # reservations fully unwound.
+    assert eng._reserved_future == 0
+    assert all(not b for b in eng._slot_blocks)
+    eng.close()
+
+
+@pytest.mark.parametrize("fmt", ["int8", "fp8_e4m3"])
+def test_paged_engine_token_identical_across_block_sizes_and_formats(
+    gpt, fmt
+):
+    """The satellite grid: paged engine == quantized generate() per
+    request across block sizes, for each quantized KV format (the scale
+    pools ride the same block taxonomy as the K/V pools — a scale block
+    left behind by a graft or append diverges here)."""
+    model, params, _ = gpt
+    mq = GPT(dataclasses.replace(model.config, kv_cache_quant=fmt), FP32)
+    rng = np.random.default_rng(13)
+    for bs in (4, 16):
+        reqs = [
+            (rng.integers(0, 64, size=int(rng.integers(2, 12))).astype(np.int32),
+             int(rng.integers(2, 9)))
+            for _ in range(4)
+        ]
+        # One request always crosses a block boundary mid-decode.
+        reqs.append((np.arange(2, dtype=np.int32), bs + 4))
+        eng, _ = _paged_vs_generate(mq, params, bs, reqs, num_slots=3)
+        assert eng.stats["block_append"] > 0, (fmt, bs, dict(eng.stats))
+        eng.close()
+
+
+def test_paged_prefix_sharing_cow_and_retire_orders(gpt):
+    """Shared-prefix caching end-to-end: requests sharing a system
+    prompt prefill once per UNIQUE prefix (full-block granularity, the
+    divergent partial block re-derived privately = copy-on-write), stay
+    token-identical to generate(), survive retiring in a different
+    order than they were admitted, and keep serving hits after every
+    original holder retired (the refcounted cache outlives the slots)."""
+    model, params, _ = gpt
+    rng = np.random.default_rng(17)
+    bs = 8
+    # 20-token prefix = 2 full blocks + a 4-token partial (the COW
+    # block: B re-derives it privately, so A's copy is never written).
+    pre = rng.integers(0, 64, size=20).astype(np.int32)
+    # Tails sized so every prompt spans 3 FULL blocks (l in 24..26): each
+    # request then registers its own divergent 3-block chain on top of
+    # the shared 2-block one — the COW assertion below needs them.
+    tails = [rng.integers(0, 64, size=n).astype(np.int32) for n in (4, 5, 6)]
+    # Different budgets force retirement in a different order than
+    # admission (A longest, C shortest).
+    reqs = [
+        (np.concatenate([pre, tails[0]]), 12),
+        (np.concatenate([pre, tails[1]]), 3),
+        (np.concatenate([pre, tails[2]]), 7),
+    ]
+    eng, done = _paged_vs_generate(model, params, bs, reqs, num_slots=3)
+    comps = [done[i] for i in sorted(done)]
+    # First admission misses; both followers hit the 2-block chain.
+    assert [c.prefix_cache_hit for c in comps] == [False, True, True]
+    assert [c.prefill_tokens_saved for c in comps] == [0, 16, 16]
+    assert eng.stats["prefix_hits"] == 2
+    assert eng.stats["prefill_tokens_saved"] == 32
+    # Retirement order differed from admission order (budgets 12/3/7).
+    assert eng.stats["block_append"] >= 0  # appends allowed, not required
+    # After every holder retired, the chain still serves: a fourth
+    # request with the same prefix hits without any live slot holding it.
+    p4 = np.concatenate([pre, rng.integers(0, 64, size=4).astype(np.int32)])
+    rid4 = eng.submit(p4, 4)
+    done4 = {c.id: c for c in eng.run()}[rid4]
+    assert done4.prefix_cache_hit and done4.prefill_tokens_saved == 16
+    ref = generate(
+        model, params, jnp.asarray(p4)[None], max_new_tokens=4,
+        temperature=0.0,
+    )
+    np.testing.assert_array_equal(done4.tokens, np.asarray(ref)[0])
+    # COW invariant at the allocator level: the SHARED chain (keyed by
+    # the common 2-full-block prefix) is exactly 2 blocks — the partial
+    # third block was never shared; each request's own longer chains
+    # diverge at the key (they embed the private COW block's tokens),
+    # so no other prompt can ever match into a divergent block.
+    shared_chain = eng._prefix_cache[pre[:16].tobytes()]
+    assert len(shared_chain) == 2, shared_chain
+    third_blocks = {
+        ids[2]
+        for key, ids in eng._prefix_cache.items()
+        if len(ids) >= 3 and key.startswith(pre[:16].tobytes())
+    }
+    assert len(third_blocks) >= 2, (
+        "divergent requests share a third block — COW violated"
+    )
+    eng.close()
+
+
+def test_paged_pool_exhaustion_defers_then_sheds(gpt):
+    """Admission is priced in pool headroom: with a pool sized for ~one
+    request, later submits WAIT at the queue head (admission_deferred)
+    and — with bounded admission — the overflow sheds typed. Every id
+    still resolves exactly once, and the tiny pool serves the whole
+    backlog correctly as slots retire and release blocks."""
+    model, params, _ = gpt
+    # 4 usable blocks of 8 = two 9-token+6-new requests (2 blocks each):
+    # with 3 slots, the third admission finds a free SLOT but no pool
+    # headroom — the deferral under test.
+    eng = ServingEngine(
+        model, params, num_slots=3, temperature=0.0,
+        kv_block_size=8, kv_pool_blocks=5, max_queue_depth=3,
+    )
+    with pytest.raises(ValueError, match="KV blocks"):
+        eng.submit(np.arange(40, dtype=np.int32), 10)  # can never fit
+    reqs = {}
+    shed = []
+    for i in range(5):
+        prompt = ((np.arange(9) + 3 * i) % 64).astype(np.int32)
+        rid = eng.submit(prompt, 6)
+        reqs[rid] = (prompt, 6)
+    done = {c.id: c for c in eng.run()}
+    assert sorted(done) == sorted(reqs)
+    by_reason = {}
+    for c in done.values():
+        by_reason[c.finish_reason] = by_reason.get(c.finish_reason, 0) + 1
+    assert by_reason.get("shed", 0) >= 1, by_reason
+    assert eng.stats["admission_deferred"] > 0, dict(eng.stats)
+    for rid, c in done.items():
+        if not c.ok:
+            continue
+        prompt, n_new = reqs[rid]
+        ref = generate(
+            model, params, jnp.asarray(prompt)[None],
+            max_new_tokens=n_new, temperature=0.0,
+        )
+        np.testing.assert_array_equal(c.tokens, np.asarray(ref)[0])
+    eng.close()
+
+
+def test_paged_pool_bytes_accounting(gpt, gpt_int8):
+    """Paged capacity math honesty: the measured per-block bytes of the
+    LIVE pool equal the analytic estimate exactly for both cache
+    flavors (scale pools included — a pool leaf the estimate doesn't
+    know fails here), mirroring the bucketed bytes-per-slot pin."""
+    from frl_distributed_ml_scaffold_tpu.models.generation import (
+        estimate_pool_block_bytes,
+    )
+
+    for name, (model, params, _) in (("none", gpt), ("int8", gpt_int8)):
+        eng = ServingEngine(
+            model, params, num_slots=2, temperature=0.0, kv_block_size=8
+        )
+        # 9-token prompt: one FULL block registers in the prefix cache,
+        # so utilization stays > 0 after retirement (cache-held block).
+        eng.submit(np.arange(9, dtype=np.int32), 3)
+        eng.run()
+        est = estimate_pool_block_bytes(
+            model.config, 8, kv_dtype_bytes=4  # fp32 sim cache
+        )
+        assert eng.block_bytes() == est, (name, eng.block_bytes(), est)
+        assert eng.bytes_per_slot() > 0
+        assert 0.0 < eng.pool_utilization() <= 1.0
+        assert eng.stats["pool_peak_blocks"] >= 2
+        eng.close()
+
+
+@pytest.mark.parametrize(
+    "mesh_kw",
+    [dict(data=1, model=8), dict(data=4, model=2)],
+    ids=["model_only", "data_x_model"],
+)
+def test_paged_sharded_matches_replicated(gpt, mesh_kw):
+    """Head-sharded paged serving == replicated paged serving on the
+    acceptance meshes: the pools shard over heads only (never batch —
+    blocks are shared across rows), tables/lengths ride the batch axes."""
+    model, params, tokens = gpt
+    prompt = np.asarray(tokens[0], np.int32)
+    eng_ref = ServingEngine(
+        model, params, num_slots=2, temperature=0.0, kv_block_size=8
+    )
+    rid = eng_ref.submit(prompt, 4)
+    ref = {c.id: c for c in eng_ref.run()}[rid]
+    eng_ref.close()
+    env = build_mesh(MeshConfig(**mesh_kw))
+    with mesh_context(env):
+        sharded = _shard(params, env)
+        eng = ServingEngine(
+            model, sharded, num_slots=2, temperature=0.0, kv_block_size=8
+        )
+        rid2 = eng.submit(prompt, 4)
+        out = {c.id: c for c in eng.run()}[rid2]
+        eng.close()
+    np.testing.assert_array_equal(ref.tokens, out.tokens)
+
+
+def test_paged_prefix_hit_with_overflowing_suffix_bucket(gpt):
+    """Regression (review find): a prefix hit whose seeded write window
+    overruns the slot-cache capacity — prefix m*bs + suffix bucket s_p >
+    cache bucket s_c (e.g. 16-token prefix + 48-token suffix in a
+    64-bucket) — must still be token-identical to the cold path. The
+    suffix prefill's trailing wrapped-pad garbage columns land past the
+    capacity and must be DROPPED; clipping them piled every one onto
+    position s_c - 1, clobbering the last real prompt token's K/V."""
+    model, _, _ = gpt
+    # seq_len=64 can't host l=64 + new tokens; build a 128-context twin
+    # (its wpe is context-sized, so it needs its own params).
+    big_model = GPT(
+        dataclasses.replace(model.config, seq_len=128), FP32
+    )
+    params = jit_init(
+        big_model, jax.random.randint(jax.random.key(2), (2, 8), 0, 64),
+        train=False,
+    )["params"]
+    rng = np.random.default_rng(23)
+    bs = 16
+    pre = rng.integers(0, 64, size=bs).astype(np.int32)
+    warm = np.concatenate([pre, rng.integers(0, 64, size=4).astype(np.int32)])
+    # l = 64: l_suf = 48 -> s_p = 64 while s_c = bucket(64) = 64, so the
+    # seeded writes span positions 16..79 — 16 columns past capacity.
+    big = np.concatenate([pre, rng.integers(0, 64, size=48).astype(np.int32)])
+    eng = ServingEngine(
+        big_model, params, num_slots=2, temperature=0.0, kv_block_size=bs
+    )
+    eng.submit(warm, 4)
+    eng.run()
+    rid = eng.submit(big, 5)
+    done = {c.id: c for c in eng.run()}[rid]
+    assert done.prefix_cache_hit and done.prefill_tokens_saved == bs
+    ref = generate(
+        big_model, params, jnp.asarray(big)[None], max_new_tokens=5,
+        temperature=0.0,
+    )
+    np.testing.assert_array_equal(done.tokens, np.asarray(ref)[0])
+    eng.close()
+
+
+@pytest.mark.fast
+def test_paged_decode_step_donates_pool(gpt):
+    """The donation pin at POOL scale: the paged engine's one compiled
+    decode program donates every cache leaf (pool included) and the
+    executable aliases the buffers — without it each step holds two
+    POOLS live, a far bigger spike than the bucketed double-cache."""
+    model, params, _ = gpt
+    eng = ServingEngine(
+        model, params, num_slots=2, temperature=0.0, kv_block_size=8
+    )
+    eng.submit(np.arange(5, dtype=np.int32), 3)
+    completed = list(eng.step())
+    cache = eng.cache
+    tok = jnp.zeros((eng.num_slots,), jnp.int32)
+    lowered = eng._paged_decode_fn().lower(
+        params, cache, tok, jax.random.key(0)
+    )
+
+    from frl_distributed_ml_scaffold_tpu.analysis.donation import (
+        args_info_donations,
+    )
+
+    n_cache = len(jax.tree.leaves(cache))
+    pairs = args_info_donations(lowered)
+    for p, d in pairs:
+        if p.startswith("[0][1]"):
+            assert d, f"paged cache leaf {p} not donated"
+        if p.startswith("[0][0]"):
+            assert not d, f"param leaf {p} unexpectedly donated"
+    pins.assert_aliased(lowered.compile(), min_aliases=n_cache)
+    done = {c.id: c for c in completed + eng.run()}
+    assert done
+    eng.close()
+
+
 # ------------------------------------------------------------------- bench
 
 
@@ -530,3 +841,74 @@ def test_serve_bench_int8_arm_reports_capacity_win(capsys):
     # >= 1.8x the concurrent slots of a bf16 cache at equal HBM.
     assert s["bytes_per_slot_bf16_ref"] >= 1.8 * s["hbm_bytes_per_slot"], s
     assert s["max_slots_at_hbm"] >= 1.8 * s["max_slots_at_hbm_bf16_ref"], s
+
+
+def test_serve_bench_paged_arm_capacity_and_prefix_scaling(capsys):
+    """The ISSUE 10 acceptance pin: on a mixed-length workload the paged
+    arm fits >= 1.5x the concurrent slots of the bucketed bf16 baseline
+    at equal HBM (the pinned lower bound; the int8-pool arm compounds
+    further), and the shared-prefix workload's prefill work scales with
+    UNIQUE prefixes — every repeat request saves exactly its full shared
+    blocks, corroborated per request by the Completion SLO fields."""
+    import json
+
+    sys_path_mod = __import__("sys")
+    import os as _os
+
+    tools = _os.path.join(
+        _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))),
+        "tools",
+    )
+    if tools not in sys_path_mod.path:
+        sys_path_mod.path.insert(0, tools)
+    import serve_bench
+
+    rc = serve_bench.main(
+        [
+            # The mixed-length operating point the ratio is pinned at:
+            # the longest request pushes the bucketed engine's shared
+            # bucket to 128 while the paged engine pays each row's
+            # actual blocks (~45-token average need), so the headroom is
+            # structural, not a boundary accident.
+            "--preset", "tiny", "--requests", "8", "--slots", "3",
+            "--max-new", "16", "--sim-devices", "0",
+            "--arms", "flash_replicated,flash_replicated_paged",
+        ]
+    )
+    assert rc == 0
+    lines = [
+        l for l in capsys.readouterr().out.splitlines()
+        if l.startswith("{")
+    ]
+    rows = {json.loads(l)["serving"]["arm"]: json.loads(l)["serving"]
+            for l in lines}
+    bucketed = rows["flash_replicated"]
+    paged = rows["flash_replicated_paged"]
+    assert paged["engine_stats"]["completed"] == 8
+    p = paged["paged"]
+    assert p["block_bytes"] > 0 and p["pool_peak_blocks"] > 0
+    # THE capacity acceptance: >= 1.5x concurrent slots at equal HBM vs
+    # the bucketed baseline ARM on the same workload — arm-to-arm, same
+    # cache dtype on both sides (fp32 on the sim, bf16 on chip: the
+    # paged win is structural, so the ratio carries over), with 1.5x as
+    # the pinned lower bound. The measured point here sits at ~1.8x,
+    # and the int8-pool arm compounds it further.
+    assert paged["max_slots_at_hbm"] >= 1.5 * bucketed["max_slots_at_hbm"], (
+        paged["max_slots_at_hbm"], bucketed["max_slots_at_hbm"]
+    )
+    # The paged arm's own dtype-consistent bucketed reference agrees.
+    assert paged["max_slots_at_hbm"] >= 1.5 * paged["max_slots_at_hbm_bf16_ref"], paged
+    # Shared-prefix workload: prefill scales with unique prefixes.
+    x = paged["prefix"]
+    repeats = x["requests"] - x["unique_prefixes"]
+    shared_tokens = x["prefix_blocks"] * p["block_size"]
+    assert x["prefill_tokens_saved"] == repeats * shared_tokens, x
+    assert x["prefill_tokens"] == x["prompt_tokens_total"] - x["prefill_tokens_saved"], x
+    assert x["prefix_hits"] == repeats, x
+    # Per-request corroboration: the aggregate is the sum of what each
+    # completion reports (the SLO-column satellite).
+    assert x["per_request_hits"] == repeats, x
+    assert x["per_request_tokens_saved"] == x["prefill_tokens_saved"], x
+    # The bucketed arm carries zeroed prefix SLO columns, not absent ones.
+    assert bucketed["prefix_hit_rate"] == 0.0
+    assert bucketed["prefill_tokens_saved"] == 0

@@ -61,6 +61,7 @@ DEFAULT_BASELINE = os.path.join(_REPO, "PERF_LEDGER.json")
 DEFAULT_RECIPES = ("mnist_mlp", "gpt2_medium_tp_overlap")
 
 SERVING_PROGRAM = "serving:decode_step"
+PAGED_SERVING_PROGRAM = "serving:decode_step_paged"
 
 #: Analytic row fields --check compares EXACTLY. Everything else in a row
 #: (intensity, roofline, measured) is either derived from these or
@@ -150,9 +151,12 @@ def analytic_recipe_row(name: str, workdir: str) -> dict:
     }
 
 
-def analytic_serving_row() -> dict:
+def analytic_serving_row(paged: bool = False) -> dict:
     """Same, for the serving decode step (the graft-lint program, shared
-    via analysis.runner.build_decode_step_program)."""
+    via analysis.runner.build_decode_step_program). ``paged=True`` builds
+    the ISSUE-10 block-table decode step instead
+    (build_paged_decode_step_program — the paged engine's ONE compiled
+    decode shape), so the ledger gates its census/FLOPs the same way."""
     import jax
 
     from frl_distributed_ml_scaffold_tpu.analysis.collectives import (
@@ -161,10 +165,15 @@ def analytic_serving_row() -> dict:
     )
     from frl_distributed_ml_scaffold_tpu.analysis.runner import (
         build_decode_step_program,
+        build_paged_decode_step_program,
     )
     from frl_distributed_ml_scaffold_tpu.utils.flops import jaxpr_flops
 
-    _, params, cache, _, jaxpr = build_decode_step_program()
+    build = (
+        build_paged_decode_step_program if paged
+        else build_decode_step_program
+    )
+    _, params, cache, _, jaxpr = build()
     census = collective_census(jaxpr)
     flops = jaxpr_flops(jaxpr)
     comm = sum(r.total_bytes for r in census)
@@ -313,6 +322,11 @@ def build_ledger(
             print(f"perf_ledger: measuring {SERVING_PROGRAM}", flush=True)
             row["measured"] = measure_serving()
         rows[SERVING_PROGRAM] = row
+        # The paged (block-table) decode step (ISSUE 10): analytic-only —
+        # its census/FLOPs gate like every other row; the measured paged
+        # serving numbers live in tools/serve_bench.py's paged arms.
+        print(f"perf_ledger: tracing {PAGED_SERVING_PROGRAM}", flush=True)
+        rows[PAGED_SERVING_PROGRAM] = analytic_serving_row(paged=True)
     from frl_distributed_ml_scaffold_tpu.utils.flops import (
         peak_flops_per_chip,
     )
@@ -337,9 +351,11 @@ def check_ledger(
     measured step time within a factor of ``tol`` when re-measured."""
     problems: list[str] = []
     for program, base in sorted(baseline.get("rows", {}).items()):
-        if program == SERVING_PROGRAM:
+        if program in (SERVING_PROGRAM, PAGED_SERVING_PROGRAM):
             try:
-                cur = analytic_serving_row()
+                cur = analytic_serving_row(
+                    paged=program == PAGED_SERVING_PROGRAM
+                )
             except Exception as e:
                 problems.append(
                     f"{program}: baseline program no longer traces "

@@ -16,12 +16,22 @@ emits one BENCH_TABLE-schema row per arm (printed as a JSON line;
 ``--out`` appends to a file). CPU-sim rows are diagnostics — only on-chip
 rows get committed to BENCH_TABLE.jsonl.
 
-Arms are ``{dense|flash}_{replicated|sharded}[_int8|_fp8]``; the
+Arms are ``{dense|flash}_{replicated|sharded}[_paged][_int8|_fp8]``; the
 ``_int8`` suffix serves the same workload with
-``model.kv_cache_quant=int8`` (``_fp8`` maps to ``fp8_e4m3``).
+``model.kv_cache_quant=int8`` (``_fp8`` maps to ``fp8_e4m3``), and the
+``_paged`` suffix (ISSUE 10) serves it through the block-table pool
+engine (``--block-size``/``--pool-blocks``). Paged arms report the paged
+capacity columns — block bytes, measured peak pool blocks, HBM per
+ACTIVE slot (peak blocks x block bytes / slots, prefix sharing counted
+once) and the resulting ``max_slots_at_hbm`` — and additionally run a
+SHARED-PREFIX workload (a few unique system prompts, several requests
+each) whose ``serving.prefix`` sub-dict shows prefill work scaling with
+unique prefixes rather than requests, measured per request via
+``Completion.prefix_cache_hit`` / ``prefill_tokens_saved``.
 
     python tools/serve_bench.py --preset tiny --requests 12 --slots 4
     python tools/serve_bench.py --preset tiny --arms flash_sharded,flash_sharded_int8
+    python tools/serve_bench.py --preset tiny --arms flash_replicated,flash_replicated_paged
 """
 
 from __future__ import annotations
@@ -46,11 +56,20 @@ def _parse_args(argv=None):
                    help="CPU-sim device count (0 = leave backend alone)")
     p.add_argument("--arms", default="dense_replicated,flash_replicated,"
                    "dense_sharded,flash_sharded,flash_replicated_int8,"
-                   "flash_sharded_int8",
+                   "flash_sharded_int8,flash_replicated_paged,"
+                   "flash_replicated_paged_int8",
                    help="comma-separated: "
-                   "{dense,flash}_{replicated,sharded}[_int8|_fp8]")
+                   "{dense,flash}_{replicated,sharded}[_paged][_int8|_fp8]")
     p.add_argument("--model-axis", type=int, default=2,
                    help="model-axis size for the sharded arms")
+    p.add_argument("--block-size", type=int, default=16,
+                   help="KV block size (tokens) for the paged arms "
+                   "(power of two)")
+    p.add_argument("--pool-blocks", type=int, default=0,
+                   help="KV pool size in blocks for the paged arms "
+                   "(0 = auto: never blocks admission; the capacity "
+                   "column prices slots at MEASURED peak blocks either "
+                   "way)")
     p.add_argument("--hbm-gb", type=float, default=16.0,
                    help="per-replica KV-cache HBM budget for the "
                    "max-concurrent-slots column")
@@ -164,7 +183,7 @@ def _decode_flops_per_token(model, params, num_slots: int) -> int:
     return fn_flops(step, params, cache, tok) // num_slots
 
 
-def _chaos_pass(model, run_params, args, work) -> dict:
+def _chaos_pass(model, run_params, args, work, kv_kwargs=None) -> dict:
     """Serve the workload again under injected faults (ISSUE 9): a
     bounded admission queue (2x slots) sheds the submit burst's tail, a
     microscopic deadline on every 3rd request forces typed deadline
@@ -183,7 +202,9 @@ def _chaos_pass(model, run_params, args, work) -> dict:
 
     eng = ServingEngine(
         model, run_params, num_slots=args.slots, temperature=0.0,
-        serving=ServingConfig(max_queue_depth=max(2, args.slots * 2)),
+        serving=ServingConfig(
+            max_queue_depth=max(2, args.slots * 2), **(kv_kwargs or {})
+        ),
     )
     # Warm-up discipline (the measured-pass contract everywhere in this
     # tool): compile every shape the chaos pass will hit, then reset, so
@@ -232,6 +253,74 @@ def _chaos_pass(model, run_params, args, work) -> dict:
     }
 
 
+def _bucketed_ref_bucket(cfg, work) -> int:
+    """The terminal cache bucket the BUCKETED engine reaches on this
+    workload (every slot pays it — the shared slot-array bucket grows to
+    the largest active row): the honest bf16 reference the paged
+    capacity ratio is measured against."""
+    from frl_distributed_ml_scaffold_tpu.models.generation import (
+        next_cache_bucket,
+    )
+
+    need = max(len(p) + n_new for p, n_new in work)
+    return next_cache_bucket(cfg.seq_len, need)
+
+
+def _prefix_pass(model, run_params, args, kv_kwargs) -> dict:
+    """Shared-prefix workload through the paged engine (ISSUE 10
+    acceptance): a few unique "system prompts" (each an exact number of
+    KV blocks), several requests per prompt with short unique tails.
+    Reports prefill work against the no-sharing cost, so the headline —
+    prefill scales with UNIQUE prefixes, not requests — is a measured
+    column, corroborated per request by the Completion SLO fields."""
+    import numpy as np
+
+    from frl_distributed_ml_scaffold_tpu.serving import ServingEngine
+
+    bs = kv_kwargs["kv_block_size"]
+    vocab = model.config.vocab_size
+    rng = np.random.default_rng(args.seed + 1)
+    uniq, per, prefix_blocks = 3, 3, 2
+    work = []
+    for _ in range(uniq):
+        pre = rng.integers(0, vocab, size=prefix_blocks * bs)
+        for _ in range(per):
+            tail = rng.integers(0, vocab, size=int(rng.integers(2, 6)))
+            work.append(np.concatenate([pre, tail]).astype(np.int32))
+    eng = ServingEngine(
+        model, run_params, num_slots=args.slots, temperature=0.0,
+        **kv_kwargs,
+    )
+    for p in work:
+        eng.submit(p, 4)
+    done = eng.run()
+    eng.close()
+    assert len(done) == len(work), (len(done), len(work))
+    prompt_tokens = int(sum(len(p) for p in work))
+    prefilled = int(eng.stats["prefill_tokens"])
+    saved = int(eng.stats["prefill_tokens_saved"])
+    return {
+        "unique_prefixes": uniq,
+        "requests_per_prefix": per,
+        "requests": len(work),
+        "prefix_blocks": prefix_blocks,
+        "prompt_tokens_total": prompt_tokens,
+        "prefill_tokens": prefilled,
+        "prefill_tokens_saved": saved,
+        "prefix_hits": int(eng.stats["prefix_hits"]),
+        "prefix_hit_rate": round(
+            eng.stats["prefix_hits"] / len(work), 4
+        ),
+        # Per-request corroboration (the Completion SLO fields): the
+        # aggregate savings must be exactly the sum of what each
+        # completion says it saved.
+        "per_request_hits": int(sum(c.prefix_cache_hit for c in done)),
+        "per_request_tokens_saved": int(
+            sum(c.prefill_tokens_saved for c in done)
+        ),
+    }
+
+
 def run_arm(model, params, arm: str, args, flops_per_token: int) -> dict:
     """One (decode impl, sharding) arm through the engine; returns the
     BENCH_TABLE-schema row."""
@@ -253,16 +342,22 @@ def run_arm(model, params, arm: str, args, flops_per_token: int) -> dict:
     from frl_distributed_ml_scaffold_tpu.serving import ServingEngine
 
     parts = arm.split("_")
-    if len(parts) == 2:
-        (impl, sharding), quant = parts, "none"
-    elif len(parts) == 3 and parts[2] in ("int8", "fp8"):
-        impl, sharding = parts[:2]
-        quant = {"int8": "int8", "fp8": "fp8_e4m3"}[parts[2]]
-    else:
+    suffixes = parts[2:]
+    paged = "paged" in suffixes
+    quants = [s for s in suffixes if s in ("int8", "fp8")]
+    if (
+        len(parts) < 2
+        or parts[0] not in ("dense", "flash")
+        or parts[1] not in ("replicated", "sharded")
+        or len(quants) > 1
+        or any(s not in ("paged", "int8", "fp8") for s in suffixes)
+    ):
         raise ValueError(
             f"unknown arm {arm!r}: want "
-            "{dense,flash}_{replicated,sharded}[_int8|_fp8]"
+            "{dense,flash}_{replicated,sharded}[_paged][_int8|_fp8]"
         )
+    impl, sharding = parts[:2]
+    quant = {"int8": "int8", "fp8": "fp8_e4m3"}[quants[0]] if quants else "none"
     m = dataclasses.replace(
         model.config, decode_attention=impl, kv_cache_quant=quant
     )
@@ -287,9 +382,14 @@ def run_arm(model, params, arm: str, args, flops_per_token: int) -> dict:
         run_params = params
 
     work = _workload(model.config, args.requests, args.max_new, args.seed)
+    kv_kwargs = (
+        dict(kv_block_size=args.block_size, kv_pool_blocks=args.pool_blocks)
+        if paged else {}
+    )
     with mesh_context(env):
         eng = ServingEngine(
-            model, run_params, num_slots=args.slots, temperature=0.0
+            model, run_params, num_slots=args.slots, temperature=0.0,
+            **kv_kwargs,
         )
         # Warm-up pass: the SAME workload once through the engine, so
         # every compiled shape the measured pass will hit (each prompt
@@ -313,7 +413,7 @@ def run_arm(model, params, arm: str, args, flops_per_token: int) -> dict:
     chaos = None
     if args.chaos:
         with mesh_context(env):
-            chaos = _chaos_pass(model, run_params, args, work)
+            chaos = _chaos_pass(model, run_params, args, work, kv_kwargs)
 
     # Capacity accounting (the quantized-cache arms' raison d'être):
     # actual per-slot bytes of the terminal-bucket engine cache (scale
@@ -324,11 +424,65 @@ def run_arm(model, params, arm: str, args, flops_per_token: int) -> dict:
     )
 
     bytes_per_slot = eng.bytes_per_slot()
-    bf16_cfg = dataclasses.replace(model.config, kv_cache_quant="none")
-    bytes_bf16_ref = estimate_cache_bytes_per_slot(
-        bf16_cfg, eng.bucket, kv_dtype_bytes=2
-    )
     hbm_budget = int(args.hbm_gb * (1 << 30))
+    paged_cols = None
+    if paged:
+        # Paged capacity accounting: a concurrent slot costs what its
+        # requests actually allocated — MEASURED peak pool blocks (prefix
+        # sharing counted once, worst-case reservations included) spread
+        # over the slot array, priced at actual block bytes. The bf16
+        # bucketed reference is what the same workload costs the legacy
+        # engine: every slot pays the terminal bucket.
+        block_bytes = eng.block_bytes()
+        peak_blocks = int(eng.stats["pool_peak_blocks"])
+        bytes_per_active_slot = max(
+            1, block_bytes * peak_blocks // args.slots
+        )
+        # Dtype-consistent reference: the paged win is STRUCTURAL (fewer
+        # tokens held), so the bucketed reference prices its cache in
+        # the same element width the measured pool actually uses (fp32
+        # on the CPU sim, bf16 on chip) — except the quantized-pool
+        # arms, whose reference stays bf16 (the compounding claim:
+        # 1-byte pool vs bf16 buckets).
+        import numpy as np
+
+        ref_elem = (
+            2 if quant != "none"
+            else np.dtype(model.policy.compute_dtype).itemsize
+        )
+        bytes_bf16_ref = estimate_cache_bytes_per_slot(
+            dataclasses.replace(model.config, kv_cache_quant="none"),
+            _bucketed_ref_bucket(model.config, work),
+            kv_dtype_bytes=ref_elem,
+        )
+        paged_cols = {
+            "block_size": eng.block_size,
+            "pool_blocks": eng.pool_blocks,
+            "block_bytes": block_bytes,
+            "pool_peak_blocks": peak_blocks,
+            "pool_peak_utilization": round(
+                peak_blocks / max(eng.pool_blocks - 1, 1), 4
+            ),
+            "hbm_bytes_per_active_slot": bytes_per_active_slot,
+            "prefix_hits": int(eng.stats["prefix_hits"]),
+            "prefill_tokens": int(eng.stats["prefill_tokens"]),
+            "prefill_tokens_saved": int(
+                eng.stats["prefill_tokens_saved"]
+            ),
+            # (prefix_hit_rate lives in the arm-uniform top-level
+            # serving columns, not here — one site, no drift.)
+        }
+        max_slots = hbm_budget // bytes_per_active_slot
+    else:
+        bytes_bf16_ref = estimate_cache_bytes_per_slot(
+            dataclasses.replace(model.config, kv_cache_quant="none"),
+            eng.bucket, kv_dtype_bytes=2,
+        )
+        max_slots = hbm_budget // max(bytes_per_slot, 1)
+    prefix = None
+    if paged:
+        with mesh_context(env):
+            prefix = _prefix_pass(model, run_params, args, kv_kwargs)
     # SLO columns from the engine's telemetry histograms (ISSUE 7): the
     # warm-up pass's observations were dropped by reset_cache, so these
     # aggregate exactly the measured pass. TTFT is the prefill+graft
@@ -377,10 +531,19 @@ def run_arm(model, params, arm: str, args, flops_per_token: int) -> dict:
             "cache_bucket": eng.bucket,
             "hbm_bytes_per_slot": bytes_per_slot,
             "bytes_per_slot_bf16_ref": bytes_bf16_ref,
-            "max_slots_at_hbm": hbm_budget // max(bytes_per_slot, 1),
+            "max_slots_at_hbm": max_slots,
             "max_slots_at_hbm_bf16_ref": hbm_budget // max(bytes_bf16_ref, 1),
             "hbm_budget_gb": args.hbm_gb,
+            # Per-request prefix SLO columns (every arm: 0 on bucketed).
+            "prefix_hit_rate": round(
+                sum(c.prefix_cache_hit for c in done) / len(done), 4
+            ),
+            "prefill_tokens_saved": int(
+                sum(c.prefill_tokens_saved for c in done)
+            ),
             "engine_stats": dict(eng.stats),
+            **({"paged": paged_cols} if paged_cols is not None else {}),
+            **({"prefix": prefix} if prefix is not None else {}),
             **({"chaos": chaos} if chaos is not None else {}),
         },
         "note": (
@@ -430,6 +593,18 @@ def main(argv=None) -> int:
             f"{s['max_slots_at_hbm']:>8d} slots@{s['hbm_budget_gb']:g}G",
             file=sys.stderr,
         )
+        if "paged" in s:
+            p = s["paged"]
+            x = s["prefix"]
+            print(
+                f"# {'paged':>23s}: {p['block_bytes']:>6d} B/block  "
+                f"peak {p['pool_peak_blocks']} blocks  "
+                f"{p['hbm_bytes_per_active_slot']:>9d} B/active-slot  "
+                f"prefix saved {x['prefill_tokens_saved']}/"
+                f"{x['prompt_tokens_total']} tok over "
+                f"{x['requests']} reqs ({x['unique_prefixes']} unique)",
+                file=sys.stderr,
+            )
         if "chaos" in s:
             c = s["chaos"]
             print(
