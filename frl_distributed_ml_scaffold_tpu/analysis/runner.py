@@ -591,6 +591,166 @@ def build_paged_decode_step_program(
     return model, params, cache, tok, jaxpr
 
 
+def build_verify_step_program(
+    *, seq_len: int = 96, block_size: int = 16, pool_blocks: int = 9,
+    num_slots: int = 2, speculate_k: int = 2, kv_cache_quant: str = "none",
+):
+    """The tiny-GPT speculative VERIFY step as an ABSTRACT program
+    (ISSUE 11): ``(model, params, cache, tile, jaxpr)``, all shapes
+    eval_shape'd — nothing runs. The tile is the fixed ``[B, k+1]``
+    token block the paged engine compiles ONCE (no per-k ladder); the
+    cache is the same block pool as the paged decode step — the verify
+    program reads/writes it through the identical table indirection, so
+    the same no-cache-clone/no-logical-view pins apply. Shared by
+    ``lint_verify_step`` and the perf ledger, like its siblings."""
+    import jax
+    import jax.numpy as jnp
+
+    from frl_distributed_ml_scaffold_tpu.config.schema import (
+        GPTConfig,
+        PrecisionConfig,
+    )
+    from frl_distributed_ml_scaffold_tpu.models.generation import (
+        _verify_step,
+    )
+    from frl_distributed_ml_scaffold_tpu.models.gpt import GPT
+    from frl_distributed_ml_scaffold_tpu.precision import get_policy
+
+    model = GPT(
+        GPTConfig(
+            vocab_size=64, num_layers=2, num_heads=2, hidden_dim=32,
+            seq_len=seq_len, dropout=0.0, kv_cache_quant=kv_cache_quant,
+        ),
+        get_policy(PrecisionConfig(policy="fp32")),
+    )
+    m = model.clone(kv_block_size=block_size, kv_pool_blocks=pool_blocks)
+    tok = jax.ShapeDtypeStruct((num_slots, 1), jnp.int32)
+    tile = jax.ShapeDtypeStruct((num_slots, speculate_k + 1), jnp.int32)
+    params = jax.eval_shape(
+        lambda: model.init(
+            {"params": jax.random.key(0)},
+            jnp.zeros((num_slots, 4), jnp.int32),
+            train=False,
+        )["params"]
+    )
+    _, cache_vars = jax.eval_shape(
+        lambda p, t: m.apply(
+            {"params": p}, t, decode=True, mutable=["cache"]
+        ),
+        params, tok,
+    )
+    cache = cache_vars["cache"]
+
+    jaxpr = jax.make_jaxpr(
+        lambda p, c, t: _verify_step(m, p, c, t)
+    )(params, cache, tile)
+    return model, params, cache, tile, jaxpr
+
+
+def lint_verify_step(
+    *, seq_len: int = 96, block_size: int = 16, pool_blocks: int = 9,
+    num_slots: int = 2, speculate_k: int = 2, kv_cache_quant: str = "none",
+) -> Report:
+    """Lint the speculative VERIFY step (ISSUE 11) — the paged decode
+    pins re-armed on the k+1-position tile:
+
+    - no full-``seq_len`` intermediate: the verify tile must score
+      against the pool through the table indirection, never a gathered
+      logical view (k+1 queries make the gather temptation bigger, not
+      smaller);
+    - materialization budget == the largest pool leaf: the step's
+      biggest legal array is still the donated in-place pool update —
+      a per-k cache clone or a widened score materialization trips it;
+    - donation audit on the engine's ONE compiled verify program
+      (``ServingEngine._verify_fn``): every cache leaf donated, or each
+      verify holds two pools live.
+
+    Mutation-gated in tests/test_graft_lint.py alongside the paged
+    decode gates."""
+    import jax
+    import jax.numpy as jnp
+
+    from frl_distributed_ml_scaffold_tpu.serving.engine import ServingEngine
+
+    quant = kv_cache_quant != "none"
+    report = Report(
+        program="serving:verify_step_paged_int8kv" if quant
+        else "serving:verify_step_paged"
+    )
+    model, params, cache, tile, jaxpr = build_verify_step_program(
+        seq_len=seq_len, block_size=block_size, pool_blocks=pool_blocks,
+        num_slots=num_slots, speculate_k=speculate_k,
+        kv_cache_quant=kv_cache_quant,
+    )
+
+    census = collective_census(jaxpr)
+    report.meta["collective_census"] = [r.to_dict() for r in census]
+    report.meta["verify_positions"] = speculate_k + 1
+    report.extend(
+        materialization_findings(
+            jaxpr, forbidden_dim=seq_len, label="verify_step: "
+        )
+    )
+    budget = _max_pool_leaf_bytes(cache)
+    report.meta["pool_leaf_bytes"] = budget
+    from frl_distributed_ml_scaffold_tpu.analysis.materialization import (
+        oversized_intermediates,
+    )
+
+    for i in oversized_intermediates(jaxpr, budget):
+        report.add(
+            "materialization", "error", "cache-clone",
+            f"verify step materializes {i.dtype}{list(i.shape)} "
+            f"({i.bytes} bytes > the {budget}-byte pool leaf, "
+            f"{i.primitive}) — the k+1 tile must ride the table "
+            "indirection, never clone or widen the pool",
+            intermediate=i.to_dict(), budget_bytes=budget,
+        )
+
+    # Engine donation audit on the ONE compiled verify program.
+    from frl_distributed_ml_scaffold_tpu.analysis.donation import (
+        args_info_donations,
+        lowered_donations,
+    )
+
+    eng = ServingEngine(
+        model, params, num_slots=num_slots, temperature=0.0,
+        kv_block_size=block_size, kv_pool_blocks=pool_blocks,
+        speculate="ngram", speculate_k=speculate_k,
+    )
+    ver_lowered = eng._verify_fn().lower(params, cache, tile)
+    n_cache = len(jax.tree.leaves(cache))
+    pairs = args_info_donations(ver_lowered)
+    if pairs is None:
+        dons = [d.donated for d in lowered_donations(ver_lowered.as_text())]
+        if sum(dons) < n_cache:
+            report.add(
+                "donation", "error", "cache-not-donated",
+                f"verify step donates {sum(dons)} args but the pool "
+                f"cache has {n_cache} leaves — two POOLS live per "
+                "verify",
+                donated=sum(dons), cache_leaves=n_cache,
+            )
+        return report
+    undonated_cache = [
+        p for p, d in pairs if p.startswith("[0][1]") and not d
+    ]
+    for p in undonated_cache:
+        report.add(
+            "donation", "error", "cache-not-donated",
+            f"verify step does not donate cache leaf {p} — the engine "
+            "holds two POOLS live per verify",
+            path=p,
+        )
+    if not undonated_cache:
+        report.add(
+            "donation", "info", "summary",
+            f"verify step donates all {n_cache} cache leaves "
+            f"({sum(1 for _, d in pairs if d)}/{len(pairs)} args donated)",
+        )
+    return report
+
+
 def _max_pool_leaf_bytes(cache) -> int:
     """The largest block-pool leaf in a paged cache tree — the paged
     decode step's legal materialization ceiling (its biggest intermediate
@@ -813,6 +973,9 @@ def lint_all(
         # the no-logical-gather pin armed — plus its int8-pool flavor.
         emit(lint_paged_decode_step())
         emit(lint_paged_decode_step(kv_cache_quant="int8"))
+        # The speculative verify step (ISSUE 11): the ONE [B, k+1]
+        # compiled verify shape, same pins at tile width.
+        emit(lint_verify_step())
     if hygiene:
         emit(lint_hygiene())
     if robustness:

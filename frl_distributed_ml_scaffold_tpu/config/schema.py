@@ -267,6 +267,22 @@ class ServingConfig:
     # (prefill runs only on the suffix); the first divergent or partial
     # block is copy-on-write private, so shared blocks are immutable.
     prefix_cache: bool = True
+    # Speculative decoding on the paged engine (ISSUE 11). "ngram" =
+    # tier-A self-speculation: drafts come from prompt-lookup over the
+    # slot's own token history (no second model — wins on repetitive /
+    # structured text); "draft" = tier-B small draft GPT sharing the
+    # tokenizer (pass draft_model/draft_params to the engine). Greedy
+    # decode only (acceptance is exact argmax matching, so speculative
+    # output is TOKEN-IDENTICAL to generate() — a pure-perf knob);
+    # requires the paged cache (kv_block_size > 0): accept/rollback is
+    # block-table pointer bookkeeping there, never cache surgery.
+    # "off" = plain single-token decode.
+    speculate: str = "off"
+    # Draft tokens proposed per verify step: the target model scores
+    # k+1 positions in ONE batched forward, amortizing the pool read.
+    # The verify program compiles ONCE at this k (no per-k ladder);
+    # slots with fewer (or zero) drafts ride the same program.
+    speculate_k: int = 4
 
 
 @dataclass(frozen=True)

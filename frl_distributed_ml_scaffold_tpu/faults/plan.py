@@ -65,6 +65,12 @@ KNOWN_SITES = frozenset(
         # serving/engine.py: growing the KV cache to the next bucket
         # fails (allocation failure at high occupancy).
         "serve.grow",
+        # serving/engine.py: the speculative draft proposer raises
+        # mid-decode (ISSUE 11) — the slot degrades to plain
+        # single-token decode for the rest of its request (counted,
+        # never sheds, never hangs; tokens stay identical because
+        # drafting is advisory).
+        "serve.draft",
         # launcher/elastic.py: a membership heartbeat write raises OSError
         # (shared-FS outage) — drives the counted-retirement path.
         "elastic.heartbeat_write",

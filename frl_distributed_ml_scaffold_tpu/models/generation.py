@@ -126,6 +126,59 @@ def _decode_step(model: Any, params: Any, cache: Any, tok: jax.Array):
     return _take_logits(logits)[:, 0], vars_out["cache"]
 
 
+def _verify_step(model: Any, params: Any, cache: Any, toks: jax.Array):
+    """One batched speculative-VERIFY forward (ISSUE 11): ``toks [B, T]``
+    is each row's last accepted token followed by T-1 draft tokens;
+    returns (logits ``[B, T, V]`` — ALL positions, unlike ``_prefill`` —
+    and the updated cache). On a paged-cache model this is the verify
+    tile: all T K/V are scattered into the pool and every position
+    scores causally against the cache in one pass
+    (ops/decode_attention.paged_verify_attention), so position 0's
+    logits equal what ``_decode_step`` would produce and greedy
+    acceptance against them is EXACT — which is the bit-exact contract
+    speculative decoding rides. The cache indices advance by T
+    unconditionally; rejected positions are rolled back afterwards via
+    ``rewind_cache_indices`` (lengths are pointers in a paged cache, so
+    rollback is a pointer move, never cache surgery)."""
+    logits, vars_out = model.apply(
+        {"params": params, "cache": cache},
+        toks,
+        decode=True,
+        mutable=["cache"],
+    )
+    return _take_logits(logits), vars_out["cache"]
+
+
+def rewind_cache_indices(cache: Any, new_idx: jax.Array) -> Any:
+    """Speculative-decode ROLLBACK (ISSUE 11): set every row's cache
+    write cursor — the per-layer ``cache_index`` rows ``[L, B]`` and the
+    model-level ``pos_index`` ``[B]`` — to ``new_idx [B]``. A verify
+    step advances every cursor by k+1; after host-side acceptance the
+    true occupancy is ``len + accepted + 1``, so rejected draft
+    positions are abandoned by rewinding the cursors (their K/V stay in
+    the pool past the cursor, masked out of every later read and
+    overwritten by later writes — the same discipline as the bucketed
+    path's wrapped-pad garbage). Name-keyed like the pool taxonomy
+    (``POOL_LEAF_OF``): every other leaf passes through untouched, so
+    the engine can jit this with the cache donated and rollback is pure
+    pointer bookkeeping."""
+    from flax.traverse_util import flatten_dict, unflatten_dict
+
+    flat = flatten_dict(cache)
+    out = {}
+    for kp, leaf in flat.items():
+        name = kp[-1]
+        if name == "cache_index":
+            out[kp] = jnp.broadcast_to(
+                new_idx.astype(leaf.dtype)[None, :], leaf.shape
+            )
+        elif name == "pos_index":
+            out[kp] = new_idx.astype(leaf.dtype)
+        else:
+            out[kp] = leaf
+    return unflatten_dict(out)
+
+
 def _plain_stack(model: Any, params: Any) -> tuple[Any, Any]:
     """Decode always runs on the plain layer stack: a pipeline-trained
     model (``pipeline_stages > 1``) is swapped for its ``stages=1`` twin

@@ -273,12 +273,6 @@ class CausalSelfAttention(nn.Module):
                 # positions >= its private suffix, and the engine's
                 # copy-on-write admission never maps a shared block
                 # there.
-                if t != 1:
-                    raise NotImplementedError(
-                        "paged decode runs single-token steps only; "
-                        "prefill uses the contiguous slot cache and the "
-                        "engine grafts it block-wise into the pool"
-                    )
                 if block_tables is None:
                     raise ValueError(
                         "kv_block_size set but no block_tables reached "
@@ -307,34 +301,41 @@ class CausalSelfAttention(nn.Module):
                     "cache", "cache_index", jnp.zeros, (b,), jnp.int32
                 )
                 idx = ci.value  # [B]
-                # Physical write target: block tbl[idx // bs], offset
-                # idx % bs. Retired slots point at the reserved trash
-                # block 0 (and their index keeps advancing), so the
-                # lookup clamps to the table width instead of trusting
-                # idx to stay inside the logical capacity.
+                # Physical write target for the j-th tile column: block
+                # tbl[(idx + j) // bs], offset (idx + j) % bs. Retired
+                # slots point at the reserved trash block 0 (and their
+                # index keeps advancing), so the lookup clamps to the
+                # table width instead of trusting idx to stay inside the
+                # logical capacity — for the verify tile (t > 1, ISSUE
+                # 11) the same clamp also routes DRAFT positions beyond
+                # the row's allocated blocks into the trash block: the
+                # engine only appends blocks through each row's real
+                # draft count, and positions past it are padding whose
+                # scores are never accepted.
                 m_tbl = block_tables.shape[1]
+                offs = idx[:, None] + jnp.arange(t)[None, :]  # [B, t]
                 phys = jnp.take_along_axis(
                     block_tables.astype(jnp.int32),
-                    jnp.minimum(idx // bs_blk, m_tbl - 1)[:, None],
+                    jnp.minimum(offs // bs_blk, m_tbl - 1),
                     axis=1,
-                )[:, 0]  # [B]
-                off = idx % bs_blk
-                k_w = k[:, 0].astype(self.dtype)  # [B, H, hd]
-                v_w = v[:, 0].astype(self.dtype)
+                )  # [B, t]
+                off = offs % bs_blk
+                k_w = k.astype(self.dtype)  # [B, t, H, hd]
+                v_w = v.astype(self.dtype)
                 if quant:
                     from frl_distributed_ml_scaffold_tpu.ops.quantization import (
                         quantize,
                     )
 
                     # Quantize ONCE per written token over its own head
-                    # vector (the PR 6 contract): per-(row, head) scales
-                    # over hd, identical to the contiguous path's
-                    # per-(row, pos, head) scale at this position.
+                    # vector (the PR 6 contract): per-(row, pos, head)
+                    # scales over hd, identical to the contiguous path's
+                    # scale at the same position.
                     qk, sk = quantize(
-                        k_w, cfg.kv_cache_quant, channel_axes=(0, 1)
+                        k_w, cfg.kv_cache_quant, channel_axes=(0, 1, 2)
                     )
                     qv, sv = quantize(
-                        v_w, cfg.kv_cache_quant, channel_axes=(0, 1)
+                        v_w, cfg.kv_cache_quant, channel_axes=(0, 1, 2)
                     )
                     k_w, v_w = qk, qv
                     ksc.value = _constrain_kv_pool(
@@ -353,17 +354,36 @@ class CausalSelfAttention(nn.Module):
                 cv.value = _constrain_kv_pool(
                     cv.value.at[phys, off].set(v_w)
                 )
-                from frl_distributed_ml_scaffold_tpu.ops.decode_attention import (
-                    paged_decode_attention,
-                )
+                if t == 1:
+                    from frl_distributed_ml_scaffold_tpu.ops.decode_attention import (
+                        paged_decode_attention,
+                    )
 
-                y = paged_decode_attention(
-                    q[:, 0], ck.value, cv.value, idx + 1, block_tables,
-                    k_scale=ksc.value if quant else None,
-                    v_scale=vsc.value if quant else None,
-                    impl=cfg.decode_attention,
-                )[:, None]
-                ci.value = idx + 1
+                    y = paged_decode_attention(
+                        q[:, 0], ck.value, cv.value, idx + 1,
+                        block_tables,
+                        k_scale=ksc.value if quant else None,
+                        v_scale=vsc.value if quant else None,
+                        impl=cfg.decode_attention,
+                    )[:, None]
+                else:
+                    # Speculative VERIFY tile (ISSUE 11): all t = k+1
+                    # positions score against the paged cache in ONE
+                    # forward — causal inside the tile (query j attends
+                    # logical positions <= idx + j), so query 0 computes
+                    # exactly the single-token decode step's output and
+                    # greedy acceptance against these logits is exact.
+                    from frl_distributed_ml_scaffold_tpu.ops.decode_attention import (
+                        paged_verify_attention,
+                    )
+
+                    y = paged_verify_attention(
+                        q, ck.value, cv.value, idx + t, block_tables,
+                        k_scale=ksc.value if quant else None,
+                        v_scale=vsc.value if quant else None,
+                        impl=cfg.decode_attention,
+                    )
+                ci.value = idx + t
                 y = y.reshape(b, t, d)
                 y = nn.Dense(
                     d, dtype=self.dtype, name="out", dot_general=out_dg
@@ -653,6 +673,13 @@ class GPT(nn.Module):
             raise ValueError(
                 "lengths (ragged left-padded prompts) is a decode-mode "
                 "argument; training/eval batches are dense"
+            )
+        if decode and self.kv_block_size > 0 and t > 1 and lengths is not None:
+            raise NotImplementedError(
+                "paged multi-token decode is the dense VERIFY tile "
+                "(speculative decoding, ISSUE 11) — ragged lengths do "
+                "not apply; prefill stays contiguous and the engine "
+                "grafts it block-wise into the pool"
             )
 
         wte = nn.Embed(

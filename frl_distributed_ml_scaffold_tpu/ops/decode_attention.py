@@ -62,6 +62,20 @@ into a contiguous logical view: the dense fallback streams bounded
 same online-softmax ``lax.scan``, so no full-``seq_len`` array — and no
 pool-sized copy — materializes per step (graft-lint's paged decode
 program pins both).
+
+Speculative verify tile (ISSUE 11): speculative decoding proposes k
+draft tokens per row and the TARGET model scores all k+1 positions in
+one batched forward — the whole point is that the pool read (the
+bandwidth bill decode pays) is amortized over k+1 query positions
+instead of one. ``paged_verify_attention`` extends the paged kernel
+from q_len=1 to a small q TILE ``[B, T, H, D]`` with causal masking
+inside the chunk loop: query position t of a row whose total occupancy
+(tile included) is ``kv_len`` attends logical positions
+``< kv_len - T + 1 + t`` — position 0 sees exactly what a single-token
+decode step would, each later draft position additionally sees the
+drafts before it. Same scalar-prefetch block-table gather, same
+online-softmax merge, same streamed-bounded-chunk dense fallback
+(``dense_paged_verify_attention``) — contract-identical off-TPU.
 """
 
 from __future__ import annotations
@@ -255,6 +269,75 @@ def dense_paged_decode_attention(
     )
     (m, l, acc, _), _ = jax.lax.scan(step, carry0, cols)
     return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+def dense_paged_verify_attention(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    kv_len: jax.Array,
+    block_tables: jax.Array,
+    k_scale: jax.Array | None = None,
+    v_scale: jax.Array | None = None,
+) -> jax.Array:
+    """Reference VERIFY-TILE attention over a paged cache (ISSUE 11):
+    q ``[B, T, H, D]`` — the row's last accepted token plus T-1 draft
+    tokens, whose K/V have already been written into the pool at logical
+    positions ``kv_len - T .. kv_len - 1`` — against pool blocks
+    addressed through the block tables. CAUSAL inside the tile: query t
+    attends logical positions ``< kv_len - T + 1 + t``, so position 0
+    scores exactly like a single-token decode step and each draft
+    position additionally sees the drafts before it.
+
+    Streams one bounded ``[B, bs, H, D]`` block per table column through
+    the same online-softmax ``lax.scan`` as the q_len=1 reference — the
+    no-logical-view contract is unchanged; the tile only widens the
+    score strip to ``[B, H, T, bs]``. fp32 softmax throughout."""
+    _, bs, h, d = k_pool.shape
+    b, t, _, _ = q.shape
+    quant = k_scale is not None
+    q32 = q.astype(jnp.float32)
+    inv = 1.0 / np.sqrt(d)
+    cols = block_tables.astype(jnp.int32).T  # [M, B] physical ids per step
+    # Per-(row, query) occupancy: query t of row b covers base[b] + t.
+    base = kv_len.astype(jnp.int32) - (t - 1)  # length at query 0
+    qlen = base[:, None] + jnp.arange(t)[None, :]  # [B, T]
+
+    def step(carry, phys):
+        m, l, acc, j = carry
+        k_c = jnp.take(k_pool, phys, axis=0)  # [B, bs, H, D] — bounded
+        v_c = jnp.take(v_pool, phys, axis=0)
+        sc = jnp.einsum(
+            "bthd,bchd->bhtc", q32, k_c.astype(jnp.float32)
+        )  # [B, H, T, bs]
+        if quant:
+            k_s = jnp.take(k_scale, phys, axis=0).astype(jnp.float32)
+            sc = sc * jnp.transpose(k_s, (0, 2, 1))[:, :, None, :]
+        sc = sc * inv
+        kpos = j * bs + jnp.arange(bs)
+        mask = kpos[None, None, None, :] < qlen[:, None, :, None]
+        sc = jnp.where(mask, sc, _NEG_INF)
+        m_new = jnp.maximum(m, sc.max(axis=-1, keepdims=True))
+        p = jnp.where(mask, jnp.exp(sc - m_new), 0.0)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + p.sum(axis=-1, keepdims=True)
+        if quant:
+            v_s = jnp.take(v_scale, phys, axis=0).astype(jnp.float32)
+            p = p * jnp.transpose(v_s, (0, 2, 1))[:, :, None, :]
+        acc = acc * alpha + jnp.einsum(
+            "bhtc,bchd->bhtd", p, v_c.astype(jnp.float32)
+        )
+        return (m_new, l, acc, j + 1), None
+
+    carry0 = (
+        jnp.full((b, h, t, 1), _NEG_INF, jnp.float32),
+        jnp.zeros((b, h, t, 1), jnp.float32),
+        jnp.zeros((b, h, t, d), jnp.float32),
+        jnp.int32(0),
+    )
+    (m, l, acc, _), _ = jax.lax.scan(step, carry0, cols)
+    out = (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)  # [B, H, T, D]
+    return jnp.swapaxes(out, 1, 2)  # [B, T, H, D]
 
 
 # ------------------------------------------------------------------ kernel
@@ -457,6 +540,112 @@ def _paged_decode_kernel_quant(len_ref, tbl_ref, q_ref, k_ref, ks_ref,
     def _finish():
         l_safe = jnp.maximum(l_ref[:], 1e-30)
         o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+
+
+def _paged_verify_kernel(len_ref, tbl_ref, q_ref, k_ref, v_ref, o_ref,
+                         m_ref, l_ref, acc_ref, *, block_k, q_len, scale):
+    """Verify-tile sibling of ``_paged_decode_kernel`` (ISSUE 11): the
+    query is a small [T, H, D] tile, scores widen to [H, T, Bk], and the
+    causal mask is applied INSIDE the chunk loop — query t of a row at
+    total occupancy ``len_ref[b]`` admits keys at logical positions
+    ``< len - (T-1) + t``. Running max/denominator/accumulator carry the
+    extra T dim in VMEM scratch; the block-table DMA gather is the same
+    scalar-prefetch index map as the q_len=1 kernel."""
+    b_, j = pl.program_id(0), pl.program_id(1)
+    n_k = pl.num_programs(1)
+    length = len_ref[b_]
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when(j * block_k < length)
+    def _step():
+        q = q_ref[0]  # (T, H, D)
+        k_blk = k_ref[0]  # (Bk, H, D) — pool-block storage layout
+        v_blk = v_ref[0]
+        # (T, H, D) x (Bk, H, D) -> (H, T, Bk): batch over H, contract D.
+        s = lax.dot_general(
+            q, k_blk,
+            dimension_numbers=(((2,), (2,)), ((1,), (1,))),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        kpos = j * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        tpos = lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(kpos < length - (q_len - 1) + tpos, s, _NEG_INF)
+        m = m_ref[:]
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        m_ref[:] = m_new
+        l_ref[:] = l_ref[:] * alpha + p.sum(axis=-1, keepdims=True)
+        # (H, T, Bk) x (Bk, H, D) -> (H, T, D): batch H, contract Bk.
+        acc_ref[:] = acc_ref[:] * alpha + lax.dot_general(
+            p.astype(v_blk.dtype), v_blk,
+            dimension_numbers=(((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == n_k - 1)
+    def _finish():
+        l_safe = jnp.maximum(l_ref[:], 1e-30)
+        o_ref[0] = jnp.swapaxes(acc_ref[:] / l_safe, 0, 1).astype(
+            o_ref.dtype
+        )
+
+
+def _paged_verify_kernel_quant(len_ref, tbl_ref, q_ref, k_ref, ks_ref,
+                               v_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref,
+                               *, block_k, q_len, scale):
+    """Quantized-pool verify tile: 1-byte blocks upcast in VMEM, the
+    per-(position, head) scales fold into the [H, T, Bk] score strip /
+    probability rows after the dots — the ``_paged_decode_kernel_quant``
+    contract with the tile's causal mask composed on top."""
+    b_, j = pl.program_id(0), pl.program_id(1)
+    n_k = pl.num_programs(1)
+    length = len_ref[b_]
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when(j * block_k < length)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)  # (T, H, D)
+        k_blk = k_ref[0].astype(jnp.float32)  # (Bk, H, D) — VMEM upcast
+        v_blk = v_ref[0].astype(jnp.float32)
+        k_s = ks_ref[0]  # (Bk, H) fp32 scales
+        v_s = vs_ref[0]
+        s = lax.dot_general(
+            q, k_blk,
+            dimension_numbers=(((2,), (2,)), ((1,), (1,))),
+            preferred_element_type=jnp.float32,
+        ) * jnp.swapaxes(k_s, 0, 1)[:, None, :] * scale
+        kpos = j * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        tpos = lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(kpos < length - (q_len - 1) + tpos, s, _NEG_INF)
+        m = m_ref[:]
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        m_ref[:] = m_new
+        l_ref[:] = l_ref[:] * alpha + p.sum(axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + lax.dot_general(
+            p * jnp.swapaxes(v_s, 0, 1)[:, None, :], v_blk,
+            dimension_numbers=(((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == n_k - 1)
+    def _finish():
+        l_safe = jnp.maximum(l_ref[:], 1e-30)
+        o_ref[0] = jnp.swapaxes(acc_ref[:] / l_safe, 0, 1).astype(
+            o_ref.dtype
+        )
 
 
 def _kv_index_map(block_k):
@@ -876,6 +1065,183 @@ def paged_decode_attention(
     sc_spec = P(None, None, "model")
     fn = shard_map_compat(
         lambda q_, k_, v_, l_, t_, ks_, vs_: _local_paged_decode(
+            q_, k_, v_, l_, t_, impl=impl, interpret=interpret,
+            k_scale=ks_, v_scale=vs_,
+        ),
+        mesh=env.mesh,
+        in_specs=(q_spec, pool_spec, pool_spec, P(batch), tbl_spec,
+                  sc_spec, sc_spec),
+        out_specs=q_spec,
+    )
+    return fn(q, k_pool, v_pool, kv_len, block_tables, k_scale, v_scale)
+
+
+# ------------------------------------------------------ speculative verify
+
+
+def _flash_paged_verify(q, k_pool, v_pool, kv_len, tables, *, interpret,
+                        k_scale=None, v_scale=None):
+    """q ``[B, T, H, D]``, pools ``[N, bs, H, D]`` (+ optional scales),
+    tables ``[B, M]`` int32 -> ``[B, T, H, D]``. Grid is (rows, logical
+    blocks) exactly like the q_len=1 kernel; the scratch accumulators
+    carry the extra T dim."""
+    b, t, h, d = q.shape
+    _, bs, _, _ = k_pool.shape
+    n_k = tables.shape[1]
+    q_spec = pl.BlockSpec((1, t, h, d), lambda b_, j, *_refs: (b_, 0, 0, 0))
+    kv_spec = pl.BlockSpec((1, bs, h, d), _paged_kv_index_map(bs))
+    scratch = [
+        pltpu.VMEM((h, t, 1), jnp.float32),  # running max
+        pltpu.VMEM((h, t, 1), jnp.float32),  # running denom
+        pltpu.VMEM((h, t, d), jnp.float32),  # output accumulator
+    ]
+    if k_scale is None:
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, n_k),
+            in_specs=[q_spec, kv_spec, kv_spec],
+            out_specs=q_spec,
+            scratch_shapes=scratch,
+        )
+        return pl.pallas_call(
+            functools.partial(
+                _paged_verify_kernel, block_k=bs, q_len=t,
+                scale=1.0 / np.sqrt(d),
+            ),
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+            interpret=interpret,
+        )(kv_len, tables, q, k_pool, v_pool)
+    sc_spec = pl.BlockSpec((1, bs, h), _paged_scale_index_map(bs))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, n_k),
+        in_specs=[q_spec, kv_spec, sc_spec, kv_spec, sc_spec],
+        out_specs=q_spec,
+        scratch_shapes=scratch,
+    )
+    return pl.pallas_call(
+        functools.partial(
+            _paged_verify_kernel_quant, block_k=bs, q_len=t,
+            scale=1.0 / np.sqrt(d),
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(kv_len, tables, q, k_pool, k_scale, v_pool, v_scale)
+
+
+def _local_paged_verify(q, k_pool, v_pool, kv_len, tables, *, impl,
+                        interpret, k_scale=None, v_scale=None):
+    """Verify-tile attention on LOCAL (already per-shard) arrays; the
+    tile twin of ``_local_paged_decode`` with the same impl routing and
+    fallback contract."""
+    quant = k_scale is not None
+
+    def dense():
+        return dense_paged_verify_attention(
+            q, k_pool, v_pool, kv_len, tables, k_scale, v_scale
+        )
+
+    if impl == "dense":
+        return dense()
+    if impl != "flash":
+        raise KeyError(
+            f"unknown decode_attention impl {impl!r} (dense | flash)"
+        )
+    if interpret is None:
+        interpret = FORCE_INTERPRET
+    bs, d = k_pool.shape[1], q.shape[-1]
+    tileable = bs >= 8 and (bs & (bs - 1)) == 0 and d % 32 == 0
+    if not tileable:
+        if jax.default_backend() == "tpu":
+            _warn_fallback(
+                "paged verify falling back to dense: block geometry "
+                f"(bs={bs}, head_dim={d}) is not tileable (need a "
+                "power-of-two block size >= 8 and head_dim % 32 == 0)"
+            )
+        return dense()
+    if interpret is None:
+        if jax.default_backend() != "tpu":
+            return dense()
+        interpret = False
+    lens = jnp.maximum(kv_len.astype(jnp.int32), 1)
+    tbl = tables.astype(jnp.int32)
+    if quant:
+        return _flash_paged_verify(
+            q, k_pool, v_pool, lens, tbl, interpret=interpret,
+            k_scale=k_scale.astype(jnp.float32),
+            v_scale=v_scale.astype(jnp.float32),
+        )
+    return _flash_paged_verify(
+        q, k_pool, v_pool, lens, tbl, interpret=interpret
+    )
+
+
+def paged_verify_attention(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    kv_len: jax.Array,
+    block_tables: jax.Array,
+    *,
+    k_scale: jax.Array | None = None,
+    v_scale: jax.Array | None = None,
+    impl: str = "flash",
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Speculative VERIFY-TILE attention over a paged KV cache (ISSUE
+    11) — the small-q-tile sibling of ``paged_decode_attention`` and the
+    one entry point the verify step (models/gpt.py paged branch with
+    t > 1, serving engine ``_verify_fn``) routes through.
+
+    q ``[B, T, H, D]`` — T = k+1 positions per row (last accepted token
+    + k drafts), whose K/V have already been scattered into the pool at
+    logical positions ``kv_len - T .. kv_len - 1``; ``kv_len [B]`` is
+    each row's TOTAL occupancy including the tile. Causality is per
+    query position inside the tile: query t attends logical positions
+    ``< kv_len - T + 1 + t``, so query 0 computes exactly what a
+    single-token decode step would and every draft position additionally
+    sees the drafts before it — which is what makes greedy acceptance
+    exact (token-identity with ``generate()``). Sharding is identical to
+    the q_len=1 entry: the pool shards over heads only and is replicated
+    over batch; q/lengths/tables ride the batch axes."""
+    from frl_distributed_ml_scaffold_tpu.dist.mesh import (
+        BATCH_AXES,
+        current_mesh_env,
+        shard_map_compat,
+    )
+
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError(
+            "k_scale and v_scale must be passed together (a quantized "
+            "pool quantizes both of its halves)"
+        )
+    env = current_mesh_env()
+    m = env.axis_size("model") if env is not None else 1
+    h = q.shape[2]
+    if env is None or m <= 1 or h % m != 0:
+        return _local_paged_verify(
+            q, k_pool, v_pool, kv_len, block_tables, impl=impl,
+            interpret=interpret, k_scale=k_scale, v_scale=v_scale,
+        )
+    batch = BATCH_AXES if q.shape[0] % env.batch_axis_size == 0 else None
+    q_spec = P(batch, None, "model", None)
+    pool_spec = P(None, None, "model", None)
+    tbl_spec = P(batch, None)
+    if k_scale is None:
+        fn = shard_map_compat(
+            functools.partial(
+                _local_paged_verify, impl=impl, interpret=interpret
+            ),
+            mesh=env.mesh,
+            in_specs=(q_spec, pool_spec, pool_spec, P(batch), tbl_spec),
+            out_specs=q_spec,
+        )
+        return fn(q, k_pool, v_pool, kv_len, block_tables)
+    sc_spec = P(None, None, "model")
+    fn = shard_map_compat(
+        lambda q_, k_, v_, l_, t_, ks_, vs_: _local_paged_verify(
             q_, k_, v_, l_, t_, impl=impl, interpret=interpret,
             k_scale=ks_, v_scale=vs_,
         ),

@@ -13,6 +13,13 @@ from frl_distributed_ml_scaffold_tpu.serving.engine import (
     Completion,
     ServeRequest,
     ServingEngine,
+    ngram_propose,
 )
 
-__all__ = ["CacheGrowError", "Completion", "ServeRequest", "ServingEngine"]
+__all__ = [
+    "CacheGrowError",
+    "Completion",
+    "ServeRequest",
+    "ServingEngine",
+    "ngram_propose",
+]

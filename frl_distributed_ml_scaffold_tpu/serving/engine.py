@@ -70,12 +70,15 @@ from frl_distributed_ml_scaffold_tpu.models.generation import (
     _plain_stack,
     _prefill,
     _sample,
+    _verify_step,
     blocks_for_tokens,
     cache_batch_axis,
     cache_bytes_per_slot,
     cache_capacity_axis,
+    generate,
     next_cache_bucket,
     pool_block_bytes,
+    rewind_cache_indices,
 )
 from frl_distributed_ml_scaffold_tpu.telemetry import (
     Histogram,
@@ -84,6 +87,51 @@ from frl_distributed_ml_scaffold_tpu.telemetry import (
     Timeline,
     Tracer,
 )
+
+
+def ngram_propose(
+    history: np.ndarray, k: int, max_ngram: int = 3
+) -> np.ndarray:
+    """Tier-A draft proposer (ISSUE 11): prompt-lookup / n-gram
+    self-speculation. Find the most recent EARLIER occurrence of the
+    history's trailing n-gram (longest n first, n = max_ngram..1) and
+    propose the up-to-``k`` tokens that followed it — on repetitive or
+    structured text (code, templated prose, the model's own greedy
+    cycles) the continuation of a repeated n-gram is usually the same
+    tokens again, so the target model accepts most of the draft and
+    each verify step retires several tokens for one pool read.
+
+    Pure host-side numpy over the slot's own token history (prompt +
+    emitted) — no second model, no device work, deterministic. Returns
+    an empty array when nothing matches (the slot then single-steps
+    inside the shared verify program). Drafting is ADVISORY: a bad
+    draft costs only its rejected verify position, never correctness.
+    """
+    h = np.asarray(history).reshape(-1)
+    n_h = int(h.size)
+    if k < 1 or n_h < 2:
+        return h[:0]
+    for n in range(min(max_ngram, n_h - 1), 0, -1):
+        suffix = h[n_h - n :]
+        # Most recent earlier occurrence WITH a full-k continuation,
+        # else the most recent match at all. Overlapping matches are
+        # deliberately allowed — a period-p cycle matches at n_h-n-p
+        # and proposes the periodic continuation, the whole tier-A win
+        # — but a match butting against the end of history truncates
+        # its continuation (the period-1 extreme yields ONE token), so
+        # when a slightly older occurrence can fill the whole draft
+        # budget with the same pattern, prefer it. One vectorized pass
+        # (this runs per active slot per verify step — an interpreted
+        # backward scan would be O(len^2) host work per request, more
+        # than the batched verify forward it gates).
+        wins = np.lib.stride_tricks.sliding_window_view(h[:-1], n)
+        hits = np.flatnonzero((wins == suffix).all(axis=1))
+        if hits.size == 0:
+            continue
+        full = hits[hits <= n_h - n - k]
+        i = int(full[-1]) if full.size else int(hits[-1])
+        return h[i + n : i + n + k].copy()
+    return h[:0]
 
 
 class CacheGrowError(RuntimeError):
@@ -149,6 +197,13 @@ class Completion:
     # of it (serve_bench aggregates these into its SLO columns).
     prefix_cache_hit: bool = False
     prefill_tokens_saved: int = 0
+    # Speculative-decode accounting (ISSUE 11), PER REQUEST — accepted
+    # draft tokens / proposed draft tokens over this request's verify
+    # steps (0.0 when nothing was proposed, e.g. speculate=off or a
+    # degraded slot). The per-request SLO face of the aggregate
+    # serve_spec_{proposed,accepted}_total counters, the same path as
+    # prefix_cache_hit above.
+    spec_accept_rate: float = 0.0
 
     @property
     def ok(self) -> bool:
@@ -207,6 +262,12 @@ class ServingEngine:
         kv_block_size: int = 0,
         kv_pool_blocks: int = 0,
         prefix_cache: bool | None = None,
+        speculate: str | None = None,
+        speculate_k: int = 0,
+        speculate_ngram_max: int = 3,
+        speculate_window: int = 32,
+        draft_model: Any = None,
+        draft_params: Any = None,
         telemetry: MetricsRegistry | None = None,
         tracer: Tracer | None = None,
         stall_timeout_s: float = 0.0,
@@ -234,17 +295,21 @@ class ServingEngine:
         # without a config. Passing both is a caller bug, refused.
         if serving is not None:
             if (max_queue_depth or default_deadline_s or kv_block_size
-                    or kv_pool_blocks or prefix_cache is not None):
+                    or kv_pool_blocks or prefix_cache is not None
+                    or speculate is not None or speculate_k):
                 raise ValueError(
                     "pass either serving=ServingConfig(...) or the "
                     "max_queue_depth/default_deadline_s/kv_block_size/"
-                    "kv_pool_blocks/prefix_cache scalars, not both"
+                    "kv_pool_blocks/prefix_cache/speculate/speculate_k "
+                    "scalars, not both"
                 )
             max_queue_depth = serving.max_queue_depth
             default_deadline_s = serving.default_deadline_s
             kv_block_size = serving.kv_block_size
             kv_pool_blocks = serving.kv_pool_blocks
             prefix_cache = serving.prefix_cache
+            speculate = serving.speculate
+            speculate_k = serving.speculate_k
         if max_queue_depth < 0:
             raise ValueError(f"max_queue_depth={max_queue_depth} < 0")
         self.max_queue_depth = int(max_queue_depth)
@@ -300,6 +365,77 @@ class ServingEngine:
                 bytes, tuple[int, ...]
             ] = collections.OrderedDict()
 
+        # Speculative decoding (ISSUE 11): draft-propose k tokens per
+        # slot, verify all k+1 positions in ONE batched forward, accept
+        # the longest matching prefix, roll the rest back (a pointer
+        # move on the paged cache). Greedy only — acceptance is exact
+        # argmax matching, so speculative output is TOKEN-IDENTICAL to
+        # generate(); this is a pure-perf knob.
+        self.spec_mode = "off" if speculate is None else str(speculate)
+        if self.spec_mode not in ("off", "ngram", "draft"):
+            raise ValueError(
+                f"speculate={self.spec_mode!r} unknown (off | ngram | draft)"
+            )
+        self.spec_k = int(speculate_k)
+        self.spec_ngram_max = int(speculate_ngram_max)
+        self.spec_window = int(speculate_window)
+        self._draft = None
+        if self.spec_mode != "off":
+            if not self.paged:
+                raise ValueError(
+                    "speculative decoding runs on the PAGED engine "
+                    "(serving.kv_block_size > 0): accept/rollback is "
+                    "block-table pointer bookkeeping there — the "
+                    "bucketed cache has no cheap rollback"
+                )
+            if self._sample_kw["temperature"] != 0.0:
+                raise ValueError(
+                    "speculate requires greedy decode (temperature=0): "
+                    "acceptance is exact argmax matching; sampled "
+                    "speculative decode needs rejection sampling, which "
+                    "this engine does not implement"
+                )
+            if self.spec_k < 1:
+                raise ValueError(
+                    f"speculate_k={self.spec_k} < 1: a verify step must "
+                    "carry at least one draft position"
+                )
+            if self.spec_mode == "draft":
+                if draft_model is None or draft_params is None:
+                    raise ValueError(
+                        "speculate='draft' needs draft_model= and "
+                        "draft_params= (a small GPT sharing the target's "
+                        "tokenizer); use speculate='ngram' for "
+                        "model-free self-speculation"
+                    )
+                dm, dp = _plain_stack(draft_model, draft_params)
+                if dm.config.vocab_size != model.config.vocab_size:
+                    raise ValueError(
+                        "draft model must share the target tokenizer "
+                        f"(vocab {dm.config.vocab_size} != "
+                        f"{model.config.vocab_size})"
+                    )
+                # The draft proposes from a sliding WINDOW of each slot's
+                # history (one compiled propose program: bucketed ragged
+                # prefill + k greedy steps) — its cache is the window
+                # bucket, so draft memory never contends with the pool.
+                self.spec_window = min(
+                    self.spec_window, dm.config.seq_len - self.spec_k
+                )
+                if self.spec_window < 1:
+                    raise ValueError(
+                        f"draft context ({dm.config.seq_len}) cannot fit "
+                        f"a window + speculate_k={self.spec_k}"
+                    )
+                self._draft = (dm, dp)
+        # Per-slot speculation state (reset at admission): sticky
+        # degradation (draft-proposer failure -> plain decode for the
+        # rest of the request) and the per-request accept accounting
+        # behind Completion.spec_accept_rate.
+        self._slot_spec_degraded = np.zeros(self.num_slots, bool)
+        self._slot_spec_proposed = np.zeros(self.num_slots, np.int64)
+        self._slot_spec_accepted = np.zeros(self.num_slots, np.int64)
+
         # The mesh is captured ONCE: every jitted program traces under it,
         # so replicated and sharded engines never share a trace.
         from frl_distributed_ml_scaffold_tpu.dist.mesh import current_mesh_env
@@ -337,6 +473,13 @@ class ServingEngine:
         self._prefill_seeded_jit: dict[tuple[int, int], Any] = {}
         self._seed_jit: dict[tuple[int, int], Any] = {}
         self._paged_graft_jit: dict[tuple[int, int], Any] = {}
+        # Speculation programs: ONE verify shape for the whole engine
+        # lifetime (the [B, k+1] tile is fixed at construction — no
+        # per-k ladder; slots with fewer drafts pad the tile), one
+        # rollback (index rewind) shape, one draft-propose shape.
+        self._verify_jit: Any = None
+        self._rewind_jit: Any = None
+        self._draft_jit: Any = None
         # Observability: how often each compiled-shape class actually ran.
         self.stats = collections.Counter()
         # Telemetry (ISSUE 7): every metric is registered up front so both
@@ -442,6 +585,35 @@ class ServingEngine:
         self._m_prefix_hit_rate = t.gauge(
             "serve_prefix_hit_rate",
             help="prefix hits / admissions since engine start",
+        )
+        # Speculative-decode observability (ISSUE 11). Always registered
+        # (the full-catalog contract): 0 with speculate=off.
+        self._m_spec_proposed = t.counter(
+            "serve_spec_proposed_total",
+            help="draft tokens proposed to verify steps",
+        )
+        self._m_spec_accepted = t.counter(
+            "serve_spec_accepted_total",
+            help="draft tokens accepted by verify steps (bonus/corrected "
+            "tokens not counted — they are free either way)",
+        )
+        self._m_spec_verifies = t.counter(
+            "serve_spec_verify_total",
+            help="batched verify-step program invocations",
+        )
+        self._m_spec_draft_failures = t.counter(
+            "serve_spec_draft_failures_total",
+            help="draft-proposer failures (slot degraded to plain "
+            "single-token decode for the rest of its request)",
+        )
+        # On the shared log2 ladder like every histogram (counts, not
+        # seconds: tokens emitted land in the 1/2/4/8 buckets, so
+        # snapshots still merge and diff like the latency tables).
+        self._m_spec_per_verify = t.histogram(
+            "serve_spec_accepted_per_verify",
+            help="tokens emitted per SPECULATING slot per verify step "
+            "(accepted drafts + the corrected/bonus token; 1 = nothing "
+            "accepted; zero-draft slots riding the tile are excluded)",
         )
         self.watchdog = StallWatchdog(
             stall_timeout_s,
@@ -598,6 +770,9 @@ class ServingEngine:
             self._tables[:] = 0
             self._tables_dirty = True
             self._prefix_cache.clear()
+        self._slot_spec_degraded[:] = False
+        self._slot_spec_proposed[:] = 0
+        self._slot_spec_accepted[:] = 0
         self.stats.clear()
         # The warm pass's observations include compile time — drop them
         # so the measured pass's histograms report serving, not XLA.
@@ -895,6 +1070,290 @@ class ServingEngine:
                 fn, donate_argnums=(0,)
             )
         return self._paged_graft_jit[(s_c, n_priv)]
+
+    # ------------------------------------------------- speculative decoding
+
+    def _verify_fn(self):
+        """THE verify program — ONE compiled shape for the engine
+        lifetime (the [B, k+1] tile is fixed at construction; no per-k
+        bucket ladder — graft-lint's ``serving:verify_step_paged``
+        program and the compile-once test pin this). Scores all k+1
+        positions of every row against the paged cache in one forward
+        and returns the greedy argmax per position; the engine accepts
+        the longest draft prefix matching these predictions host-side
+        — exact, which is the token-identity contract."""
+        if self._verify_jit is None:
+            m = self._paged_model()
+
+            def fn(params, cache, tile):
+                logits, cache = _verify_step(m, params, cache, tile)
+                preds = jnp.argmax(
+                    logits.astype(jnp.float32), axis=-1
+                ).astype(jnp.int32)
+                return preds, cache
+
+            # Donate the cache (pool included) — same two-pools-live
+            # audit contract as the decode program.
+            self._verify_jit = jax.jit(fn, donate_argnums=(1,))
+        return self._verify_jit
+
+    def _rewind_fn(self):
+        """Speculative ROLLBACK: rewind every row's cache/position
+        cursor to its accepted length (``generation.rewind_cache_indices``
+        — a pointer move over the donated cache; rejected positions'
+        K/V are simply abandoned past the cursor). Freed tail blocks
+        are returned host-side by ``step()``'s release loop."""
+        if self._rewind_jit is None:
+            self._rewind_jit = jax.jit(
+                rewind_cache_indices, donate_argnums=(0,)
+            )
+        return self._rewind_jit
+
+    def _draft_fn(self):
+        """Tier-B draft proposer: ONE compiled program batching every
+        slot — a ragged (left-padded) prefill of each slot's trailing
+        ``spec_window`` history tokens through the small draft model,
+        then k greedy steps (``generation.generate`` under jit). The
+        draft's cache is the window bucket, re-derived per proposal
+        round: no persistent draft cache to keep consistent, nothing to
+        roll back — the target pool stays the only stateful cache."""
+        if self._draft_jit is None:
+            dm, _ = self._draft
+            k, w = self.spec_k, self.spec_window
+
+            def fn(params, windows, lengths):
+                out = generate(
+                    dm, params, windows, max_new_tokens=k,
+                    temperature=0.0, prompt_lengths=lengths,
+                )
+                return out[:, w:]
+
+            self._draft_jit = jax.jit(fn)
+        return self._draft_jit
+
+    def _propose(self) -> dict[int, np.ndarray]:
+        """Draft tokens per active slot for this step's verify tile:
+        ``{slot: [n_j] int tokens}`` with ``1 <= n_j <= spec_k``; a slot
+        missing here single-steps (rides the verify program with zero
+        drafts, or the plain decode program when nobody proposed).
+
+        Caps: ``n_j <= remaining_budget - 1`` — emitting more than the
+        budget is wasted AND would write cache positions past the
+        admission reservation (the worst-case block count covers exactly
+        positions < prompt + budget - 1). Failure semantics (ISSUE 9
+        style): a proposer exception — including the ``serve.draft``
+        fault site — degrades THAT slot to plain decode for the rest of
+        its request (counted, never sheds, never hangs; output is
+        identical because drafting is advisory)."""
+        out: dict[int, np.ndarray] = {}
+        want: list[int] = []
+        for slot in np.flatnonzero(self._active):
+            slot = int(slot)
+            req = self._req[slot]
+            r = req.max_new_tokens - len(self._tokens[slot])
+            if r < 2 or self._slot_spec_degraded[slot]:
+                continue
+            try:
+                faults.maybe_raise("serve.draft", key=req.id)
+            except Exception as e:
+                self._spec_degrade(slot, e)
+                continue
+            want.append(slot)
+        if not want:
+            return out
+        if self.spec_mode == "ngram":
+            for slot in want:
+                req = self._req[slot]
+                r = req.max_new_tokens - len(self._tokens[slot])
+                try:
+                    hist = np.concatenate(
+                        [req.prompt,
+                         np.asarray(self._tokens[slot], np.int32)]
+                    )
+                    d = ngram_propose(
+                        hist, min(self.spec_k, r - 1),
+                        max_ngram=self.spec_ngram_max,
+                    )
+                except Exception as e:
+                    self._spec_degrade(slot, e)
+                    continue
+                if d.size:
+                    out[slot] = d.astype(np.int64)
+            return out
+        # Draft-model tier: one batched propose over every wanting slot.
+        w = self.spec_window
+        windows = np.zeros((self.num_slots, w), np.int32)
+        lens = np.ones(self.num_slots, np.int32)
+        for slot in want:
+            req = self._req[slot]
+            hist = np.concatenate(
+                [req.prompt, np.asarray(self._tokens[slot], np.int32)]
+            )[-w:]
+            windows[slot, w - hist.size :] = hist
+            lens[slot] = hist.size
+        try:
+            with self._trace_ctx():
+                drafts = np.asarray(jax.device_get(
+                    self._draft_fn()(
+                        self._draft[1],
+                        jnp.asarray(windows),
+                        jnp.asarray(lens),
+                    )
+                ))
+        except Exception as e:
+            # The batched call failed: every participating slot degrades
+            # (a crashing draft model would crash every later round too).
+            for slot in want:
+                self._spec_degrade(slot, e)
+            return out
+        for slot in want:
+            req = self._req[slot]
+            r = req.max_new_tokens - len(self._tokens[slot])
+            d = drafts[slot, : min(self.spec_k, r - 1)]
+            if d.size:
+                out[slot] = d.astype(np.int64)
+        return out
+
+    def _spec_degrade(self, slot: int, err: Exception) -> None:
+        """Sticky per-request degradation to plain single-token decode."""
+        self._slot_spec_degraded[slot] = True
+        self._m_spec_draft_failures.inc()
+        self.stats["spec_draft_failures"] += 1
+        from frl_distributed_ml_scaffold_tpu.utils.logging import get_logger
+
+        get_logger().warning(
+            "serving: draft proposer failed for slot %d (%s: %s) — "
+            "degrading to plain single-token decode for this request",
+            slot, type(err).__name__, err,
+        )
+
+    def _spec_verify(self, drafts: dict[int, np.ndarray]) -> None:
+        """One speculative step over the slot array: build the [B, k+1]
+        tile (each row's last token + its drafts, zero-padded — pad
+        positions write into the trash block or past-occupancy slots,
+        masked out of every later read), run THE verify program, accept
+        each row's longest draft prefix matching the greedy predictions
+        (EXACT, so the emitted tokens equal plain decode's), then roll
+        back: freed tail blocks return to the pool via the reservation
+        accounting and every row's device cursor rewinds to its accepted
+        length. Deadlines/sheds/quarantine see the emitted group
+        ATOMICALLY (PR 9 semantics): eos/budget retire mid-group, the
+        deadline check runs once after the group."""
+        k = self.spec_k
+        tile = np.zeros((self.num_slots, k + 1), np.int32)
+        tile[:, 0] = self._last_tok
+        n_prop = 0
+        for slot, d in drafts.items():
+            tile[slot, 1 : 1 + d.size] = d
+            self._slot_spec_proposed[slot] += d.size
+            n_prop += int(d.size)
+        self._m_spec_proposed.inc(n_prop)
+        self.stats["spec_proposed"] += n_prop
+        t0 = time.perf_counter()
+        fn = self._verify_fn()
+        with self._trace_ctx():
+            preds, self.cache = fn(
+                self.params, self.cache, jnp.asarray(tile)
+            )
+        preds = np.asarray(jax.device_get(preds))
+        dt = time.perf_counter() - t0
+        n_active = int(self._active.sum())
+        self.stats["decode_verify"] += 1
+        self.stats["decode_steps"] += 1
+        self.stats["slot_steps"] += n_active
+        self._m_decodes.inc()
+        self._m_spec_verifies.inc()
+        self._phase(
+            "verify", t0=t0, dur_s=dt, trace=self._engine_trace,
+            active=n_active, proposed=n_prop, k=k,
+        )
+        self.watchdog.beat()
+        if self.telemetry.enabled:
+            for name, v in _hbm_gib().items():
+                (self._m_hbm_used if name == "hbm_in_use_gib"
+                 else self._m_hbm_peak).set(v)
+
+        bs = self.block_size
+        for slot in range(self.num_slots):
+            if not self._active[slot]:
+                continue
+            req = self._req[slot]
+            d = drafts.get(slot)
+            n_j = int(d.size) if d is not None else 0
+            # Longest accepted draft prefix: draft j survives iff it
+            # equals the target's greedy prediction at position j-1.
+            a = 0
+            while a < n_j and tile[slot, a + 1] == preds[slot, a]:
+                a += 1
+            # Emitted group: the accepted drafts plus the target's own
+            # next token at the first mismatch (the bonus/corrected
+            # token — a verify step ALWAYS emits at least one token, so
+            # speculation never regresses below plain decode).
+            group = [int(x) for x in tile[slot, 1 : a + 1]]
+            group.append(int(preds[slot, a]))
+            per_tok = dt / len(group)
+            emitted = 0
+            retired = False
+            for i, tok in enumerate(group):
+                self._tokens[slot].append(tok)
+                self._len[slot] += 1
+                self._latency[slot].append(per_tok)
+                self._m_tpot.observe(per_tok)
+                self._last_tok[slot] = tok
+                emitted += 1
+                if i < a:
+                    self._slot_spec_accepted[slot] += 1
+                    self._m_spec_accepted.inc()
+                    self.stats["spec_accepted"] += 1
+                if self._finishes(slot, tok):
+                    retired = True
+                    break
+            self.stats["step_tokens"] += emitted
+            if n_j > 0:
+                # Accepted-per-verify accounting covers SPECULATING
+                # slots only — a zero-draft slot riding the tile is
+                # just a plain decode step for that row (its token
+                # still counts in slot_steps/step_tokens, the honest
+                # whole-engine invocations-per-token denominator).
+                self.stats["spec_emitted"] += emitted
+                self.stats["spec_slot_verifies"] += 1
+                self._m_spec_per_verify.observe(float(emitted))
+            self._phase(
+                "decode_tick", t0=t0, dur_s=dt, trace=req.trace,
+                parent=req.span, slot=slot,
+                token=len(self._tokens[slot]) - 1, spec_emitted=emitted,
+            )
+            if retired:
+                continue
+            # Mid-decode deadline cancellation, ATOMIC over the group.
+            if self._expired(req):
+                self._m_deadline.inc()
+                self._retire(slot, "deadline")
+                continue
+            # Table-pointer rollback: blocks appended for rejected draft
+            # positions return to the pool — popped off the table tail,
+            # re-counted as future reservations (the admission worst
+            # case still holds, so later appends still cannot fail).
+            need = (int(self._len[slot]) - 1) // bs + 1
+            while len(self._slot_blocks[slot]) > need:
+                bid = self._slot_blocks[slot].pop()
+                self._tables[slot, len(self._slot_blocks[slot])] = 0
+                self._tables_dirty = True
+                self._deref(bid)
+                self._slot_future[slot] += 1
+                self._reserved_future += 1
+                self.stats["block_rollback"] += 1
+        # Cursor rewind, one donated pointer-move program: the verify
+        # step advanced every row's cache_index/pos_index by k+1; the
+        # true occupancy is the accepted length (cache_index == _len - 1,
+        # the engine invariant). Inactive rows park at 0 — their writes
+        # land in the trash block regardless.
+        new_idx = np.where(self._active, self._len - 1, 0).astype(np.int32)
+        with self._trace_ctx():
+            self.cache = self._rewind_fn()(
+                self.cache, jnp.asarray(new_idx)
+            )
+        self._m_pool_util.set(self.pool_utilization())
 
     # ------------------------------------------------- paged block allocator
 
@@ -1300,6 +1759,9 @@ class ServingEngine:
         self._active[slot] = True
         self._latency[slot] = [dt]
         self._last_tok[slot] = tok
+        self._slot_spec_degraded[slot] = False
+        self._slot_spec_proposed[slot] = 0
+        self._slot_spec_accepted[slot] = 0
         # The first sampled token can already finish the request.
         self._finishes(slot, tok)
         return True
@@ -1337,6 +1799,11 @@ class ServingEngine:
             ),
             prefill_tokens_saved=(
                 int(self._slot_tokens_saved[slot]) if self.paged else 0
+            ),
+            spec_accept_rate=(
+                float(self._slot_spec_accepted[slot])
+                / float(self._slot_spec_proposed[slot])
+                if self._slot_spec_proposed[slot] else 0.0
             ),
         )
         self._completed.append(comp)
@@ -1387,6 +1854,13 @@ class ServingEngine:
         if not self._active.any():
             return self._completed
 
+        # Speculative proposal round (ISSUE 11): drafts per slot for
+        # this step's verify tile — BEFORE the block-append loop, which
+        # must cover each row's draft write positions too.
+        drafts: dict[int, np.ndarray] = {}
+        if self.paged and self.spec_mode != "off":
+            drafts = self._propose()
+
         if self.paged:
             # Paged growth: a row crossing a block boundary APPENDS one
             # reserved block to its table — a host-side int write plus a
@@ -1395,8 +1869,16 @@ class ServingEngine:
             # the only failure left is the injected serve.grow fault
             # (kept on the same degrade-per-row contract as bucketed
             # growth: the crossing row retires typed, the batch lives).
+            # A speculating row additionally covers its draft write
+            # positions (idx .. idx + n_drafts — within the worst-case
+            # reservation because drafts are capped at budget - 1);
+            # rejected drafts hand their tail blocks back after the
+            # verify step.
             for slot in np.flatnonzero(self._active):
-                need = (int(self._len[slot]) - 1) // self.block_size + 1
+                extra = len(drafts.get(int(slot), ()))
+                need = (
+                    int(self._len[slot]) - 1 + extra
+                ) // self.block_size + 1
                 while len(self._slot_blocks[slot]) < need:
                     try:
                         faults.maybe_raise(
@@ -1416,6 +1898,7 @@ class ServingEngine:
                             "(%s: %s); retiring it, batch keeps decoding",
                             slot, type(e).__name__, e,
                         )
+                        drafts.pop(int(slot), None)
                         self._retire(int(slot), "error")
                         break
                     self._reserved_future -= 1
@@ -1442,6 +1925,12 @@ class ServingEngine:
                     "block_tables": jnp.asarray(self._tables),
                 }
                 self._tables_dirty = False
+            if drafts:
+                # At least one slot speculates: the whole batch rides
+                # the ONE verify program (slots without drafts
+                # single-step inside it — the mixed-batch contract).
+                self._spec_verify(drafts)
+                return self._completed
         else:
             # Bucket must hold every active row's next write position: an
             # active row holds cache_index == _len - 1 (prefill sets idx=l
@@ -1492,6 +1981,12 @@ class ServingEngine:
             "decode_paged" if self.paged else f"decode_{self.bucket}"
         ] += 1
         self.stats["decode_steps"] += 1
+        # Slot-level invocation accounting (ISSUE 11): a plain step is
+        # one invocation per active slot, emitting one token each — the
+        # denominator serve_bench's decode-invocations-per-token column
+        # (and the speculative reduction ratio) reads from.
+        self.stats["slot_steps"] += int(self._active.sum())
+        self.stats["step_tokens"] += int(self._active.sum())
         self._m_decodes.inc()
         # One engine-lane span per slot-array decode program...
         self._phase(

@@ -430,6 +430,109 @@ def test_paged_dense_fallback_streams_bounded_chunks():
         )
 
 
+# --------------------------------------------- speculative verify tile
+
+
+@pytest.mark.fast
+@pytest.mark.parametrize("t", [2, 4], ids=lambda t: f"T{t}")
+def test_paged_verify_matches_per_position_decode(t):
+    """ISSUE 11 op gate: the verify tile's causal contract — query j of
+    a row whose TOTAL occupancy (tile included) is L scores exactly
+    like a single-token decode step at occupancy L - T + 1 + j, for
+    every position, at mixed occupancies — and the interpreter-mode
+    verify kernel matches the streamed reference to kernel tolerance.
+    This per-position equality is what makes greedy acceptance exact
+    (the engine's token-identity pin rides on it)."""
+    b, s, h, d, bs = 3, 64, 4, 64, 8
+    rng = np.random.default_rng(7 + t)
+    k = jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
+    q = jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.float32)
+    k_pool, v_pool, tables, _ = _paged_from_contiguous(
+        k, v, bs, b * (s // bs) + 5, seed=t
+    )
+    lens = jnp.asarray([t + 1, 29, s], jnp.int32)  # total incl. tile
+    out = da.dense_paged_verify_attention(q, k_pool, v_pool, lens, tables)
+    for j in range(t):
+        ref = da.dense_decode_attention(
+            q[:, j], k, v, lens - (t - 1) + j
+        )
+        np.testing.assert_allclose(
+            np.asarray(out[:, j]), np.asarray(ref), atol=2e-6, rtol=2e-6,
+            err_msg=f"verify position {j} diverged from its decode step",
+        )
+    kern = da._local_paged_verify(
+        q, k_pool, v_pool, lens, tables, impl="flash", interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(kern), np.asarray(out), atol=2e-6, rtol=2e-6
+    )
+
+
+@pytest.mark.fast
+def test_paged_verify_quant_matches_quant_reference():
+    """Quantized pools under the verify tile: the streamed reference's
+    per-position slices track the contiguous quantized decode reference
+    (same once-quantized values), and the interpreter-mode quantized
+    verify kernel matches the streamed reference."""
+    b, s, h, d, bs, t = 3, 64, 4, 64, 8, 3
+    rng = np.random.default_rng(3)
+    k = jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
+    q = jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.float32)
+    from frl_distributed_ml_scaffold_tpu.ops.quantization import quantize
+
+    kq, ks = quantize(k, "int8", channel_axes=(0, 1, 2))
+    vq, vs = quantize(v, "int8", channel_axes=(0, 1, 2))
+    ks, vs = ks[..., 0], vs[..., 0]
+    kqp, vqp, tables, sc = _paged_from_contiguous(
+        kq, vq, bs, b * (s // bs) + 5, seed=5, scales=(ks, vs)
+    )
+    ksp, vsp = sc
+    lens = jnp.asarray([t, 21, s], jnp.int32)
+    out = da.dense_paged_verify_attention(
+        q, kqp, vqp, lens, tables, ksp, vsp
+    )
+    for j in range(t):
+        ref = da.dense_decode_attention_quant(
+            q[:, j], kq, vq, lens - (t - 1) + j, ks, vs
+        )
+        np.testing.assert_allclose(
+            np.asarray(out[:, j]), np.asarray(ref), atol=1e-5, rtol=1e-5,
+        )
+    kern = da._local_paged_verify(
+        q, kqp, vqp, lens, tables, impl="flash", interpret=True,
+        k_scale=ksp, v_scale=vsp,
+    )
+    np.testing.assert_allclose(
+        np.asarray(kern), np.asarray(out), atol=1e-5, rtol=1e-5
+    )
+
+
+@pytest.mark.fast
+def test_paged_verify_dense_fallback_streams_bounded_chunks():
+    """The no-logical-view contract holds at tile width: k+1 query
+    positions make the gather temptation bigger, not smaller — the
+    verify fallback still streams one bounded block per table column
+    (no intermediate carries the M*bs logical-context dim), which is
+    what the graft-lint serving:verify_step_paged pin relies on."""
+    b, h, d, t = 2, 2, 32, 3
+    for bs, m_tbl in ((8, 8), (16, 32)):
+        s = bs * m_tbl
+        n_blocks = 2 * b * m_tbl + 1
+        q = jnp.zeros((b, t, h, d), jnp.float32)
+        k_pool = jnp.zeros((n_blocks, bs, h, d), jnp.float32)
+        tables = jnp.zeros((b, m_tbl), jnp.int32)
+        lens = jnp.asarray([t, s], jnp.int32)
+        jaxpr = jax.make_jaxpr(
+            lambda *a: da.dense_paged_verify_attention(*a)
+        )(q, k_pool, k_pool, lens, tables)
+        pins.assert_no_dim_materialized(
+            jaxpr, s,
+            f"verify fallback materialized the M*bs={s} logical view",
+        )
+
+
 # --------------------------------------------------------- model decode
 
 

@@ -399,6 +399,53 @@ def test_grow_failure_degrades_not_dies(gpt):
     eng.close()
 
 
+@pytest.mark.fast
+@pytest.mark.serving
+def test_draft_failure_degrades_slot_to_plain_decode(gpt):
+    """ISSUE 11 fault-matrix row: the ``serve.draft`` site fails the
+    speculative draft proposer mid-decode — the hit slot degrades to
+    plain single-token decode for the REST of its request (sticky,
+    counted in serve_spec_draft_failures_total), the batch never sheds
+    or hangs (every id resolves), tokens stay identical to generate()
+    (drafting is advisory — acceptance was exact anyway), and a NEW
+    request admitted after the fault clears speculates again."""
+    model, params = gpt
+    rep = np.tile(np.asarray([7, 11, 13, 5], np.int32), 5)
+    rand = np.arange(9, dtype=np.int32) * 5 % 64
+    eng = ServingEngine(
+        model, params, num_slots=2, temperature=0.0,
+        kv_block_size=8, speculate="ngram", speculate_k=4,
+    )
+    # at=2: the first propose round works (verify steps happen), the
+    # second consultation kills ONE slot's drafting.
+    with faults.active(FaultPlan([dict(site="serve.draft", at=2, times=1)])):
+        ra = eng.submit(rep, 10)
+        rb = eng.submit(rand, 6)
+        done = {c.id: c for c in eng.run()}
+    assert sorted(done) == [ra, rb], "a faulted slot hung or shed"
+    for rid, (p, n) in {ra: (rep, 10), rb: (rand, 6)}.items():
+        assert done[rid].ok
+        np.testing.assert_array_equal(
+            done[rid].tokens, _solo(model, params, p, n),
+            err_msg=f"request {rid} diverged under draft failure",
+        )
+    assert eng.stats["spec_draft_failures"] == 1
+    assert (
+        eng.telemetry.counter("serve_spec_draft_failures_total").value == 1
+    )
+    # Fault cleared + slot re-admitted: speculation resumes (the
+    # degradation is per-request, not per-engine) — drafts are proposed
+    # and verify steps run again.
+    before = eng.stats["decode_verify"]
+    before_prop = eng.stats["spec_proposed"]
+    rc = eng.submit(rep, 8)
+    done2 = {c.id: c for c in eng.run()}
+    assert done2[rc].ok
+    assert eng.stats["decode_verify"] > before
+    assert eng.stats["spec_proposed"] > before_prop
+    eng.close()
+
+
 @pytest.mark.serving
 def test_chaos_non_faulted_requests_token_identical(gpt):
     """The acceptance headline: queue bound + deadlines + poison at once,

@@ -507,6 +507,74 @@ def test_paged_decode_step_lint_clean_and_mutations_trip():
         pins.assert_no_dim_materialized(gather_jaxpr, seq_len)
 
 
+def test_verify_step_lint_clean_and_mutations_trip():
+    """ISSUE 11's gates on the speculative verify program: the shipped
+    [B, k+1] verify step passes the paged pins at tile width (no
+    full-seq_len materialization, nothing bigger than one pool leaf,
+    every cache leaf donated on the engine's ONE compiled verify
+    program); the canonical regressions trip — (a) scoring the tile
+    against a GATHERED logical cache view (the k+1 queries make the
+    gather temptation bigger, and it materializes the full context the
+    table indirection exists to avoid), and (b) dropping the verify
+    program's cache donation (two pools live per verify)."""
+    import jax.numpy as jnp
+
+    from frl_distributed_ml_scaffold_tpu.analysis.runner import (
+        _max_pool_leaf_bytes,
+        build_verify_step_program,
+        lint_verify_step,
+    )
+    from frl_distributed_ml_scaffold_tpu.models.generation import (
+        _verify_step,
+    )
+
+    # Positive gates: both pool flavors, same analyzers the CLI arms
+    # for serving:verify_step_paged.
+    for quant in ("none", "int8"):
+        rep = lint_verify_step(kv_cache_quant=quant)
+        assert rep.ok, [f.message for f in rep.errors()]
+        assert rep.meta["verify_positions"] == 3
+        assert rep.meta["pool_leaf_bytes"] > 0
+
+    model, params, cache, tile, jaxpr = build_verify_step_program()
+    seq_len = model.config.seq_len
+    budget = _max_pool_leaf_bytes(cache)
+    pins.assert_no_dim_materialized(jaxpr, seq_len)
+    pins.assert_max_materialized_bytes(jaxpr, budget)
+
+    # Mutation (a): verify the tile against the gathered logical view —
+    # a [B, T, M*bs]-scored step materializes the full context.
+    def gathered_scores(c, t):
+        kp = c["blocks"]["attn"]["key_pool"]  # [L, N, bs, H, hd]
+        tbl = c["block_tables"]  # [B, M]
+        g = jnp.take(kp[0], tbl, axis=0)  # [B, M, bs, H, hd]
+        b, m = tbl.shape
+        logical = g.reshape(b, m * kp.shape[2], -1)  # full context
+        q = jnp.zeros((b, t.shape[1], logical.shape[-1]), jnp.float32)
+        return jnp.einsum("btd,bsd->bts", q, logical.astype(jnp.float32))
+
+    mut_jaxpr = jax.make_jaxpr(gathered_scores)(cache, tile)
+    with pytest.raises(AssertionError, match=str(seq_len)):
+        pins.assert_no_dim_materialized(mut_jaxpr, seq_len)
+
+    # Mutation (b): dropped donation on the verify program — the audit
+    # fires at the args_info level exactly like the decode programs.
+    m = model.clone(kv_block_size=16, kv_pool_blocks=9)
+
+    def fn(p, c, t):
+        logits, c = _verify_step(m, p, c, t)
+        return jnp.argmax(logits, -1), c
+
+    donated = jax.jit(fn, donate_argnums=(1,)).lower(params, cache, tile)
+    dropped = jax.jit(fn).lower(params, cache, tile)
+    n_cache = len(jax.tree.leaves(cache))
+    pins.assert_donated(donated, min_donated=n_cache)
+    with pytest.raises(AssertionError, match="donated"):
+        pins.assert_donated(dropped, min_donated=1)
+    d_pairs = args_info_donations(dropped)
+    assert not any(d for _, d in d_pairs), "dropped donation still marked"
+
+
 @pytest.mark.fast
 def test_mutation_dropped_donation_is_caught():
     """THE donation mutation gate: the same program jitted with and

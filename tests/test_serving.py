@@ -750,6 +750,347 @@ def test_paged_decode_step_donates_pool(gpt):
     eng.close()
 
 
+# ------------------------------------------------- speculative decoding
+
+
+@pytest.fixture(scope="module")
+def gpt_draft(gpt):
+    """A 1-layer draft GPT sharing the target's tokenizer (tier B)."""
+    model, _, _ = gpt
+    dcfg = dataclasses.replace(
+        model.config, num_layers=1, num_heads=2, hidden_dim=32
+    )
+    draft = GPT(dcfg, FP32)
+    tokens = jax.random.randint(jax.random.key(9), (2, 8), 0, 64)
+    dparams = jit_init(draft, tokens, train=False)["params"]
+    return draft, dparams
+
+
+_ACCEPTING_CACHE: dict[tuple, np.ndarray] = {}
+
+
+def _accepting_prompt(model, params, k: int = 4) -> np.ndarray:
+    """A prompt whose greedy continuation ACCEPTS n-gram drafts: probe a
+    few seeds of the model's own greedy text and keep the one whose
+    simulated tier-A acceptance scores highest. Derived at runtime
+    because the fixture's params — and hence the model's greedy cycles
+    — depend on the ambient ``jax_threefry_partitionable`` state, which
+    earlier mesh-building tests flip; a hardcoded "repetitive" pattern
+    is only repetitive under one variant. Deterministic for whichever
+    variant is active (greedy decode + fixed probe seeds)."""
+    key = (id(params), getattr(model.config, "kv_cache_quant", "none"))
+    if key in _ACCEPTING_CACHE:
+        return _ACCEPTING_CACHE[key]
+    import os as _os
+    import sys as _sys
+
+    tools = _os.path.join(
+        _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))),
+        "tools",
+    )
+    if tools not in _sys.path:
+        _sys.path.insert(0, tools)
+    from serve_bench import _simulate_ngram_serving
+
+    rng = np.random.default_rng(0)
+    best = None
+    for _ in range(8):
+        s = rng.integers(0, 64, size=6).astype(np.int32)
+        full = np.asarray(
+            generate(
+                model, params, jnp.asarray(s)[None], max_new_tokens=30,
+                temperature=0.0,
+            )
+        )[0].astype(np.int32)
+        prompt, cont = full[:20], full[20:]
+        toks, ver = _simulate_ngram_serving(prompt, cont, k)
+        score = toks / max(ver, 1)
+        if best is None or score > best[0]:
+            best = (score, prompt)
+        if score >= 2.5:
+            break
+    assert best[0] > 1.0, (
+        f"no probed continuation accepts any drafts (best {best[0]})"
+    )
+    _ACCEPTING_CACHE[key] = best[1]
+    return best[1]
+
+
+def _spec_reqs(rng, bs, model, params):
+    """Mixed speculative workload: a draft-accepting prompt (the model's
+    own repetitive text — high acceptance), a random prompt (high
+    rejection -> rollback), and a short prompt whose budget crosses a
+    block boundary MID-DECODE."""
+    return [
+        (_accepting_prompt(model, params), bs + 6),
+        (rng.integers(0, 64, size=9).astype(np.int32), 6),
+        (np.arange(2, dtype=np.int32), bs + 4),
+    ]
+
+
+@pytest.mark.fast
+def test_ngram_propose_unit():
+    """Tier-A proposer semantics: a periodic history proposes its own
+    continuation (full k even when the most recent overlapping match
+    truncates), a fresh history proposes nothing, and the continuation
+    never exceeds k."""
+    from frl_distributed_ml_scaffold_tpu.serving.engine import ngram_propose
+
+    cyc = np.asarray([3, 9, 4, 3, 9, 4, 3, 9, 4], np.int64)
+    d = ngram_propose(cyc, 4)
+    np.testing.assert_array_equal(d, [3, 9, 4, 3])  # the periodic draft
+    const = np.full(8, 5, np.int64)
+    np.testing.assert_array_equal(ngram_propose(const, 3), [5, 5, 5])
+    fresh = np.arange(10)  # no repeated n-gram anywhere
+    assert ngram_propose(fresh, 4).size == 0
+    assert ngram_propose(cyc, 2).size == 2
+    assert ngram_propose(np.asarray([1]), 4).size == 0
+
+
+def test_spec_ngram_token_identical_grid(gpt):
+    """THE speculative acceptance core (ISSUE 11): greedy speculative
+    decode == generate() token-for-token across block sizes, on a mixed
+    batch where some slots speculate (repetitive prompt, high accept)
+    and some effectively single-step (random prompts, rejected drafts
+    -> rollback, including across a block boundary). Verify steps and
+    block rollbacks must actually have happened, and every reservation
+    unwinds."""
+    model, params, _ = gpt
+    rng = np.random.default_rng(31)
+    for bs in (4, 16):
+        eng, done = _paged_vs_generate(
+            model, params, bs, _spec_reqs(rng, bs, model, params),
+            num_slots=3, speculate="ngram", speculate_k=4,
+        )
+        assert eng.stats["decode_verify"] > 0, dict(eng.stats)
+        assert eng.stats["spec_proposed"] > 0
+        # Acceptance happened (the accepting prompt) — the deterministic
+        # every-draft-rejected rollback-ACROSS-a-boundary case lives in
+        # the draft test below.
+        assert 0 < eng.stats["spec_accepted"] <= eng.stats["spec_proposed"]
+        assert eng.stats["spec_emitted"] >= eng.stats["spec_slot_verifies"]
+        assert eng._reserved_future == 0
+        assert all(not b for b in eng._slot_blocks)
+        eng.close()
+
+
+@pytest.mark.parametrize("fmt", ["int8", "fp8_e4m3"])
+def test_spec_token_identical_quantized_pools(gpt, fmt):
+    """The acceptance grid's quantized column: speculative decode over
+    int8/fp8 pools (verify tile quantizes once per written position,
+    scale pools ride the same table indirection) stays token-identical
+    to the quantized generate()."""
+    model, params, _ = gpt
+    mq = GPT(dataclasses.replace(model.config, kv_cache_quant=fmt), FP32)
+    rng = np.random.default_rng(37)
+    eng, _ = _paged_vs_generate(
+        mq, params, 8, _spec_reqs(rng, 8, mq, params), num_slots=3,
+        speculate="ngram", speculate_k=4,
+    )
+    assert eng.stats["decode_verify"] > 0, (fmt, dict(eng.stats))
+    eng.close()
+
+
+def test_spec_draft_token_identical_and_windowed(gpt, gpt_draft):
+    """Tier B: a (random, hence mostly-rejected) draft model proposes
+    through the windowed batched propose program; output is still
+    token-identical — acceptance is exact, drafting is advisory — and
+    the constant full-k rejections force the rollback-ACROSS-a-block-
+    boundary acceptance case: draft positions straddling a boundary
+    append a block before the verify, rejection pops it back to the
+    free list (block_rollback > 0), and every reservation unwinds."""
+    model, params, _ = gpt
+    draft, dparams = gpt_draft
+    rng = np.random.default_rng(41)
+    eng, done = _paged_vs_generate(
+        model, params, 8, _spec_reqs(rng, 8, model, params), num_slots=3,
+        speculate="draft", speculate_k=3,
+        draft_model=draft, draft_params=dparams,
+    )
+    assert eng.stats["decode_verify"] > 0
+    assert eng.stats["spec_proposed"] > 0
+    assert eng.stats["block_rollback"] > 0, dict(eng.stats)
+    assert eng._reserved_future == 0
+    assert all(not b for b in eng._slot_blocks)
+    # Per-request SLO column: rates are well-formed fractions.
+    for c in done.values():
+        assert 0.0 <= c.spec_accept_rate <= 1.0
+    eng.close()
+
+
+def test_spec_rollback_returns_blocks_to_pool(gpt):
+    """The rollback acceptance pin: after every request retires, pool
+    utilization returns to baseline — EXACTLY zero with the prefix
+    cache off (every block the verify steps ever appended, including
+    rejected-draft tails, is back on the free list) — and the
+    utilization gauge agrees."""
+    model, params, _ = gpt
+    rng = np.random.default_rng(43)
+    eng = ServingEngine(
+        model, params, num_slots=3, temperature=0.0, kv_block_size=4,
+        prefix_cache=False, speculate="ngram", speculate_k=4,
+    )
+    for p, n in _spec_reqs(rng, 4, model, params):
+        eng.submit(p, n)
+    done = eng.run()
+    assert len(done) == 3
+    assert eng.stats["decode_verify"] > 0
+    assert eng.pool_utilization() == 0.0, dict(eng.stats)
+    assert len(eng._free) == eng.pool_blocks - 1
+    assert eng._reserved_future == 0
+    assert (eng._ref == 0).all()
+    snap = eng.telemetry.snapshot()
+    assert snap["serve_pool_utilization"] == 0.0
+    eng.close()
+
+
+@pytest.mark.fast
+def test_spec_verify_compiles_once_and_donates_pool(gpt):
+    """No per-k ladder: the verify program object is constructed once
+    and reused for every verify step regardless of how many drafts each
+    slot carries; and it donates every cache leaf (pool included) with
+    the executable aliasing the buffers — the decode-program audit at
+    tile width."""
+    model, params, _ = gpt
+    eng = ServingEngine(
+        model, params, num_slots=2, temperature=0.0, kv_block_size=8,
+        speculate="ngram", speculate_k=3,
+    )
+    fn_a = eng._verify_fn()
+    assert eng._verify_fn() is fn_a, "verify program rebuilt per call"
+    eng.submit(np.tile(np.asarray([3, 9], np.int32), 6), 10)
+    eng.submit(np.arange(5, dtype=np.int32), 4)
+    done = eng.run()
+    assert len(done) == 2 and eng.stats["decode_verify"] > 0
+    assert eng._verify_fn() is fn_a, "verify program rebuilt mid-serve"
+
+    cache = eng.cache
+    tile = jnp.zeros((eng.num_slots, eng.spec_k + 1), jnp.int32)
+    lowered = fn_a.lower(params, cache, tile)
+    from frl_distributed_ml_scaffold_tpu.analysis.donation import (
+        args_info_donations,
+    )
+
+    n_cache = len(jax.tree.leaves(cache))
+    for p, d in args_info_donations(lowered):
+        if p.startswith("[0][1]"):
+            assert d, f"verify cache leaf {p} not donated"
+        if p.startswith("[0][0]"):
+            assert not d, f"param leaf {p} unexpectedly donated"
+    pins.assert_aliased(lowered.compile(), min_aliases=n_cache)
+    eng.close()
+
+
+@pytest.mark.fast
+def test_spec_eos_mid_group_truncates(gpt):
+    """A group whose accepted drafts contain eos retires AT the eos
+    (tokens after it are discarded — speculation must not overshoot the
+    engine's eos-retirement contract)."""
+    model, params, _ = gpt
+    p = np.tile(np.asarray([7, 11, 13, 5], np.int32), 5)
+    ref = np.asarray(
+        generate(model, params, jnp.asarray(p)[None], max_new_tokens=12,
+                 temperature=0.0)
+    )[0]
+    # Choose eos = a token greedy emits mid-stream (position 4 of 12).
+    eos = int(ref[p.size + 4])
+    first = int(np.flatnonzero(ref[p.size:] == eos)[0])
+    eng = ServingEngine(
+        model, params, num_slots=1, temperature=0.0, eos_id=eos,
+        kv_block_size=8, speculate="ngram", speculate_k=4,
+    )
+    rid = eng.submit(p, 12)
+    done = {c.id: c for c in eng.run()}[rid]
+    assert done.finish_reason == "eos"
+    assert len(done.tokens) == p.size + first + 1, (
+        len(done.tokens), p.size, first
+    )
+    np.testing.assert_array_equal(
+        done.tokens, ref[: p.size + first + 1]
+    )
+    eng.close()
+
+
+@pytest.mark.fast
+def test_spec_knob_refusals(gpt, gpt_draft):
+    """Guard rails: speculate needs the paged cache and greedy decode;
+    draft tier needs a draft model with the same tokenizer; k >= 1;
+    config-and-scalars double-specification refused."""
+    from frl_distributed_ml_scaffold_tpu.config.schema import ServingConfig
+
+    model, params, _ = gpt
+    draft, dparams = gpt_draft
+    with pytest.raises(ValueError, match="PAGED"):
+        ServingEngine(model, params, num_slots=1, speculate="ngram")
+    with pytest.raises(ValueError, match="greedy"):
+        ServingEngine(
+            model, params, num_slots=1, kv_block_size=8,
+            speculate="ngram", speculate_k=2, temperature=0.5,
+        )
+    with pytest.raises(ValueError, match="draft_model"):
+        ServingEngine(
+            model, params, num_slots=1, kv_block_size=8,
+            speculate="draft", speculate_k=2,
+        )
+    with pytest.raises(ValueError, match="speculate_k"):
+        ServingEngine(
+            model, params, num_slots=1, kv_block_size=8,
+            speculate="ngram", speculate_k=0,
+        )
+    with pytest.raises(ValueError, match="unknown"):
+        ServingEngine(
+            model, params, num_slots=1, kv_block_size=8,
+            speculate="medusa", speculate_k=2,
+        )
+    bad_draft = GPT(
+        dataclasses.replace(draft.config, vocab_size=32), FP32
+    )
+    with pytest.raises(ValueError, match="tokenizer"):
+        ServingEngine(
+            model, params, num_slots=1, kv_block_size=8,
+            speculate="draft", speculate_k=2,
+            draft_model=bad_draft, draft_params=dparams,
+        )
+    with pytest.raises(ValueError, match="not both"):
+        ServingEngine(
+            model, params, num_slots=1,
+            serving=ServingConfig(kv_block_size=8, speculate="ngram"),
+            speculate_k=3,
+        )
+
+
+def test_spec_telemetry_counters_and_slo_columns(gpt):
+    """The telemetry satellite: spec counters live in the catalog (and
+    move), the accepted-per-verify histogram counts exactly the
+    speculating slot-verifies on the shared log2 ladder, and the
+    aggregate counters reconcile with the engine stats and with the
+    per-request Completion.spec_accept_rate columns."""
+    model, params, _ = gpt
+    eng = ServingEngine(
+        model, params, num_slots=2, temperature=0.0, kv_block_size=8,
+        speculate="ngram", speculate_k=4,
+    )
+    rid_rep = eng.submit(_accepting_prompt(model, params), 10)
+    rid_rand = eng.submit(
+        np.random.default_rng(3).integers(0, 64, size=7).astype(np.int32), 5
+    )
+    done = {c.id: c for c in eng.run()}
+    snap = eng.telemetry.snapshot()
+    assert snap["serve_spec_proposed_total"] == eng.stats["spec_proposed"] > 0
+    assert snap["serve_spec_accepted_total"] == eng.stats["spec_accepted"]
+    assert snap["serve_spec_verify_total"] == eng.stats["decode_verify"] > 0
+    h = snap["serve_spec_accepted_per_verify"]
+    assert h["count"] == eng.stats["spec_slot_verifies"] > 0
+    # The histogram's total mass equals emitted tokens (sum over
+    # observations of tokens-per-verify) — log2 buckets, exact values
+    # 1/2/4 land on bucket bounds, so check via the stats ledger.
+    assert eng.stats["spec_emitted"] >= eng.stats["spec_slot_verifies"]
+    # Per-request SLO columns: the accepting prompt actually accepted.
+    assert done[rid_rep].spec_accept_rate > 0.0
+    assert 0.0 <= done[rid_rand].spec_accept_rate <= 1.0
+    eng.close()
+
+
 # ------------------------------------------------------------------- bench
 
 
@@ -912,3 +1253,62 @@ def test_serve_bench_paged_arm_capacity_and_prefix_scaling(capsys):
     # The bucketed arm carries zeroed prefix SLO columns, not absent ones.
     assert bucketed["prefix_hit_rate"] == 0.0
     assert bucketed["prefill_tokens_saved"] == 0
+    # ... and zeroed/neutral speculative SLO columns (ISSUE 11).
+    assert bucketed["speculate"] == "off"
+    assert bucketed["spec_accept_rate"] == 0.0
+    assert bucketed["decode_invocations_per_token"] == 1.0
+
+
+def test_serve_bench_spec_arm_acceptance_pin(capsys):
+    """THE ISSUE 11 acceptance pin, measured: on the repetitive-text
+    workload the n-gram speculative arm retires >= 2.0 tokens per
+    verify step and cuts target-model decode invocations per emitted
+    token >= 1.8x vs speculate=off on the same workload (the analytic
+    twin is the perf ledger's serving:verify_step_paged row — k+1
+    positions amortize one pool read). The measured point here sits at
+    ~2.9x on both columns."""
+    import json
+
+    sys_path_mod = __import__("sys")
+    import os as _os
+
+    tools = _os.path.join(
+        _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))),
+        "tools",
+    )
+    if tools not in sys_path_mod.path:
+        sys_path_mod.path.insert(0, tools)
+    import serve_bench
+
+    rc = serve_bench.main(
+        [
+            "--preset", "tiny", "--requests", "4", "--slots", "2",
+            "--max-new", "8", "--sim-devices", "0",
+            "--arms", "flash_replicated_paged_spec_ngram",
+        ]
+    )
+    assert rc == 0
+    lines = [
+        l for l in capsys.readouterr().out.splitlines()
+        if l.startswith("{")
+    ]
+    assert len(lines) == 1, lines
+    s = json.loads(lines[0])["serving"]
+    assert s["speculate"] == "ngram"
+    assert s["engine_stats"]["completed"] == 4
+    assert s["engine_stats"]["decode_verify"] > 0
+    sp = s["spec_repetitive"]
+    # Acceptance bar 1: mean accepted tokens per verify step >= 2.0.
+    assert sp["mean_accepted_per_verify"] >= 2.0, sp
+    # Acceptance bar 2: >= 1.8x fewer decode invocations per token.
+    assert sp["invocations_reduction_x"] >= 1.8, sp
+    assert sp["off_decode_invocations_per_token"] == 1.0
+    assert sp["decode_invocations_per_token"] <= 1.0 / 1.8 + 1e-9, sp
+    # Reconciliation: accepted drafts + one bonus per verify = emitted.
+    assert sp["accepted"] <= sp["proposed"]
+    assert 0.0 < sp["acceptance_rate"] <= 1.0
+    # The mixed-length MAIN workload also ran speculatively (its
+    # acceptance is workload-dependent; the columns just have to be
+    # well-formed and the engine invocation ledger consistent).
+    assert 0.0 <= s["spec_accept_rate"] <= 1.0
+    assert 0.0 < s["decode_invocations_per_token"] <= 1.0

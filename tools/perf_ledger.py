@@ -62,6 +62,7 @@ DEFAULT_RECIPES = ("mnist_mlp", "gpt2_medium_tp_overlap")
 
 SERVING_PROGRAM = "serving:decode_step"
 PAGED_SERVING_PROGRAM = "serving:decode_step_paged"
+VERIFY_SERVING_PROGRAM = "serving:verify_step_paged"
 
 #: Analytic row fields --check compares EXACTLY. Everything else in a row
 #: (intensity, roofline, measured) is either derived from these or
@@ -151,12 +152,18 @@ def analytic_recipe_row(name: str, workdir: str) -> dict:
     }
 
 
-def analytic_serving_row(paged: bool = False) -> dict:
+def analytic_serving_row(paged: bool = False, verify: bool = False) -> dict:
     """Same, for the serving decode step (the graft-lint program, shared
     via analysis.runner.build_decode_step_program). ``paged=True`` builds
     the ISSUE-10 block-table decode step instead
     (build_paged_decode_step_program — the paged engine's ONE compiled
-    decode shape), so the ledger gates its census/FLOPs the same way."""
+    decode shape); ``verify=True`` builds the ISSUE-11 speculative
+    verify step (build_verify_step_program — the [B, k+1] tile), whose
+    row additionally carries the amortization twin: ``positions_per
+    _invocation`` = k+1 query positions score against ONE pool read, so
+    ``flops_per_position`` sits next to the decode row's whole-step
+    FLOPs — the analytic face of serve_bench's measured
+    accepted-per-verify / invocations-per-token columns."""
     import jax
 
     from frl_distributed_ml_scaffold_tpu.analysis.collectives import (
@@ -166,19 +173,21 @@ def analytic_serving_row(paged: bool = False) -> dict:
     from frl_distributed_ml_scaffold_tpu.analysis.runner import (
         build_decode_step_program,
         build_paged_decode_step_program,
+        build_verify_step_program,
     )
     from frl_distributed_ml_scaffold_tpu.utils.flops import jaxpr_flops
 
     build = (
-        build_paged_decode_step_program if paged
+        build_verify_step_program if verify
+        else build_paged_decode_step_program if paged
         else build_decode_step_program
     )
-    _, params, cache, _, jaxpr = build()
+    _, params, cache, tok, jaxpr = build()
     census = collective_census(jaxpr)
     flops = jaxpr_flops(jaxpr)
     comm = sum(r.total_bytes for r in census)
     chips = jax.device_count()
-    return {
+    row = {
         "flops_per_step": flops,
         "collective_bytes_per_step": comm,
         "collectives": {
@@ -190,6 +199,11 @@ def analytic_serving_row(paged: bool = False) -> dict:
         "intensity_flops_per_byte": round(flops / max(comm, 1), 3),
         "roofline": _roofline(flops, comm, chips),
     }
+    if verify:
+        positions = int(tok.shape[1])  # the k+1 tile
+        row["positions_per_invocation"] = positions
+        row["flops_per_position"] = flops // positions
+    return row
 
 
 def measure_recipe(name: str, steps: int, workdir: str) -> dict:
@@ -327,6 +341,12 @@ def build_ledger(
         # serving numbers live in tools/serve_bench.py's paged arms.
         print(f"perf_ledger: tracing {PAGED_SERVING_PROGRAM}", flush=True)
         rows[PAGED_SERVING_PROGRAM] = analytic_serving_row(paged=True)
+        # The speculative verify step (ISSUE 11): analytic-only — the
+        # k+1-position tile amortizes the pool read, so its
+        # flops_per_position row is the analytic twin of serve_bench's
+        # measured accepted-per-verify / invocations-per-token columns.
+        print(f"perf_ledger: tracing {VERIFY_SERVING_PROGRAM}", flush=True)
+        rows[VERIFY_SERVING_PROGRAM] = analytic_serving_row(verify=True)
     from frl_distributed_ml_scaffold_tpu.utils.flops import (
         peak_flops_per_chip,
     )
@@ -351,10 +371,13 @@ def check_ledger(
     measured step time within a factor of ``tol`` when re-measured."""
     problems: list[str] = []
     for program, base in sorted(baseline.get("rows", {}).items()):
-        if program in (SERVING_PROGRAM, PAGED_SERVING_PROGRAM):
+        if program in (
+            SERVING_PROGRAM, PAGED_SERVING_PROGRAM, VERIFY_SERVING_PROGRAM
+        ):
             try:
                 cur = analytic_serving_row(
-                    paged=program == PAGED_SERVING_PROGRAM
+                    paged=program == PAGED_SERVING_PROGRAM,
+                    verify=program == VERIFY_SERVING_PROGRAM,
                 )
             except Exception as e:
                 problems.append(

@@ -16,8 +16,9 @@ emits one BENCH_TABLE-schema row per arm (printed as a JSON line;
 ``--out`` appends to a file). CPU-sim rows are diagnostics — only on-chip
 rows get committed to BENCH_TABLE.jsonl.
 
-Arms are ``{dense|flash}_{replicated|sharded}[_paged][_int8|_fp8]``; the
-``_int8`` suffix serves the same workload with
+Arms are
+``{dense|flash}_{replicated|sharded}[_paged][_int8|_fp8][_spec[_ngram|_draft]]``;
+the ``_int8`` suffix serves the same workload with
 ``model.kv_cache_quant=int8`` (``_fp8`` maps to ``fp8_e4m3``), and the
 ``_paged`` suffix (ISSUE 10) serves it through the block-table pool
 engine (``--block-size``/``--pool-blocks``). Paged arms report the paged
@@ -29,9 +30,24 @@ each) whose ``serving.prefix`` sub-dict shows prefill work scaling with
 unique prefixes rather than requests, measured per request via
 ``Completion.prefix_cache_hit`` / ``prefill_tokens_saved``.
 
+The ``_spec`` suffix (ISSUE 11, paged arms only) serves the workload
+with speculative decoding — ``_spec_ngram`` (default) drafts via
+prompt-lookup self-speculation, ``_spec_draft`` via a tiny draft GPT
+sharing the tokenizer (``--speculate-k`` drafts per verify). Spec arms
+report acceptance-rate, mean-accepted-per-verify, and
+decode-invocations-per-token next to the TTFT/TPOT columns, and
+additionally run a REPETITIVE-TEXT workload (periodic prompts whose
+greedy continuations cycle — where n-gram drafting shines) whose
+``serving.spec_repetitive`` sub-dict measures the speculative headline:
+mean accepted tokens per verify and the invocations-per-token reduction
+vs a ``speculate=off`` engine on the same workload. Output is
+token-identical either way (greedy acceptance is exact), so the columns
+are pure perf.
+
     python tools/serve_bench.py --preset tiny --requests 12 --slots 4
     python tools/serve_bench.py --preset tiny --arms flash_sharded,flash_sharded_int8
     python tools/serve_bench.py --preset tiny --arms flash_replicated,flash_replicated_paged
+    python tools/serve_bench.py --preset tiny --arms flash_replicated_paged_spec_ngram
 """
 
 from __future__ import annotations
@@ -57,9 +73,10 @@ def _parse_args(argv=None):
     p.add_argument("--arms", default="dense_replicated,flash_replicated,"
                    "dense_sharded,flash_sharded,flash_replicated_int8,"
                    "flash_sharded_int8,flash_replicated_paged,"
-                   "flash_replicated_paged_int8",
-                   help="comma-separated: "
-                   "{dense,flash}_{replicated,sharded}[_paged][_int8|_fp8]")
+                   "flash_replicated_paged_int8,"
+                   "flash_replicated_paged_spec_ngram",
+                   help="comma-separated: {dense,flash}_{replicated,"
+                   "sharded}[_paged][_int8|_fp8][_spec[_ngram|_draft]]")
     p.add_argument("--model-axis", type=int, default=2,
                    help="model-axis size for the sharded arms")
     p.add_argument("--block-size", type=int, default=16,
@@ -70,6 +87,8 @@ def _parse_args(argv=None):
                    "(0 = auto: never blocks admission; the capacity "
                    "column prices slots at MEASURED peak blocks either "
                    "way)")
+    p.add_argument("--speculate-k", type=int, default=4,
+                   help="draft tokens per verify step for the _spec arms")
     p.add_argument("--hbm-gb", type=float, default=16.0,
                    help="per-replica KV-cache HBM budget for the "
                    "max-concurrent-slots column")
@@ -183,16 +202,21 @@ def _decode_flops_per_token(model, params, num_slots: int) -> int:
     return fn_flops(step, params, cache, tok) // num_slots
 
 
-def _chaos_pass(model, run_params, args, work, kv_kwargs=None) -> dict:
+def _chaos_pass(
+    model, run_params, args, work, kv_kwargs=None, draft_kwargs=None
+) -> dict:
     """Serve the workload again under injected faults (ISSUE 9): a
     bounded admission queue (2x slots) sheds the submit burst's tail, a
     microscopic deadline on every 3rd request forces typed deadline
     misses, and the second request's prefill is poisoned via the
-    ``serve.prefill`` fault site. Reports the degradation headline: shed
-    rate, deadline-miss rate, quarantine count, and the p50/p99 token
-    latency of the NON-faulted requests — the number that proves chaos
-    does not bleed into healthy traffic (tests/test_faults.py pins the
-    stronger token-identity form)."""
+    ``serve.prefill`` fault site. A speculative engine additionally gets
+    its draft proposer failed once via ``serve.draft`` (ISSUE 11) — the
+    hit slot degrades to plain decode, counted, output unchanged.
+    Reports the degradation headline: shed rate, deadline-miss rate,
+    quarantine count, and the p50/p99 token latency of the NON-faulted
+    requests — the number that proves chaos does not bleed into healthy
+    traffic (tests/test_faults.py pins the stronger token-identity
+    form)."""
     import numpy as np
 
     from frl_distributed_ml_scaffold_tpu import faults
@@ -205,6 +229,7 @@ def _chaos_pass(model, run_params, args, work, kv_kwargs=None) -> dict:
         serving=ServingConfig(
             max_queue_depth=max(2, args.slots * 2), **(kv_kwargs or {})
         ),
+        **(draft_kwargs or {}),
     )
     # Warm-up discipline (the measured-pass contract everywhere in this
     # tool): compile every shape the chaos pass will hit, then reset, so
@@ -217,10 +242,13 @@ def _chaos_pass(model, run_params, args, work, kv_kwargs=None) -> dict:
     # The warm pass consumed ids 0..n-1: the chaos pass's ids continue at
     # n, so the poison key targets its SECOND request (id n+1) — inside
     # the queue bound, failing at prefill.
-    plan = FaultPlan(
-        [dict(site="serve.prefill", key=str(len(work) + 1), times=0)],
-        seed=args.seed,
-    )
+    specs = [dict(site="serve.prefill", key=str(len(work) + 1), times=0)]
+    if (kv_kwargs or {}).get("speculate", "off") != "off":
+        # Fail the first draft-proposal consultation: the hit slot
+        # degrades to plain single-token decode (sticky for its
+        # request) and the run completes token-identically.
+        specs.append(dict(site="serve.draft", at=1, times=1))
+    plan = FaultPlan(specs, seed=args.seed)
     with faults.active(plan):
         for i, (prompt, n_new) in enumerate(work):
             eng.submit(
@@ -250,6 +278,7 @@ def _chaos_pass(model, run_params, args, work, kv_kwargs=None) -> dict:
         "nonfaulted_p99_ms": (
             round(float(np.percentile(lat, 99)) * 1e3, 3) if lat else 0.0
         ),
+        "draft_failures": int(eng.stats["spec_draft_failures"]),
     }
 
 
@@ -287,6 +316,11 @@ def _prefix_pass(model, run_params, args, kv_kwargs) -> dict:
         for _ in range(per):
             tail = rng.integers(0, vocab, size=int(rng.integers(2, 6)))
             work.append(np.concatenate([pre, tail]).astype(np.int32))
+    # The prefix pass measures prefix caching, not speculation — strip
+    # the spec knobs so spec arms reuse it unchanged.
+    kv_kwargs = {
+        k: v for k, v in kv_kwargs.items() if not k.startswith("speculate")
+    }
     eng = ServingEngine(
         model, run_params, num_slots=args.slots, temperature=0.0,
         **kv_kwargs,
@@ -321,6 +355,167 @@ def _prefix_pass(model, run_params, args, kv_kwargs) -> dict:
     }
 
 
+def _build_draft(cfg):
+    """Tier-B draft model for the _spec_draft arms: a 1-layer GPT
+    sharing the target's tokenizer (vocab), ~1/8 the width — small
+    enough that a propose round costs a fraction of a verify step."""
+    import jax
+
+    from frl_distributed_ml_scaffold_tpu.config.schema import (
+        GPTConfig,
+        PrecisionConfig,
+    )
+    from frl_distributed_ml_scaffold_tpu.models.gpt import GPT
+    from frl_distributed_ml_scaffold_tpu.precision import get_policy
+
+    dcfg = GPTConfig(
+        vocab_size=cfg.vocab_size, num_layers=1, num_heads=2,
+        hidden_dim=max(32, cfg.hidden_dim // 8), seq_len=cfg.seq_len,
+        dropout=0.0,
+    )
+    draft = GPT(dcfg, get_policy(PrecisionConfig(policy="fp32")))
+    tokens = jax.random.randint(
+        jax.random.key(7), (2, 8), 0, dcfg.vocab_size
+    )
+    dparams = jax.jit(
+        lambda: draft.init(
+            {"params": jax.random.key(7)}, tokens, train=False
+        )["params"]
+    )()
+    return dict(draft_model=draft, draft_params=dparams)
+
+
+def _simulate_ngram_serving(prompt, cont, k: int) -> tuple[int, int]:
+    """Replay the engine's tier-A accept loop on a KNOWN greedy
+    continuation, host-side: returns (tokens emitted, verify steps).
+    Greedy decode is deterministic, so this is exactly what the engine
+    will do — the workload builder uses it to SCORE candidate texts by
+    repetitiveness (no device work)."""
+    import numpy as np
+
+    from frl_distributed_ml_scaffold_tpu.serving.engine import ngram_propose
+
+    hist = np.asarray(prompt)
+    i, verifies = 0, 0
+    while i < len(cont):
+        r = len(cont) - i
+        d = ngram_propose(hist, min(k, r - 1)) if r >= 2 else hist[:0]
+        a = 0
+        while a < d.size and d[a] == cont[i + a]:
+            a += 1
+        emitted = min(a + 1, r)
+        verifies += 1
+        hist = np.concatenate([hist, cont[i : i + emitted]])
+        i += emitted
+    return len(cont), verifies
+
+
+def _spec_workload(model, params, n_requests: int, max_new: int, seed: int,
+                   k: int = 4):
+    """REPETITIVE-TEXT workload for the speculative arms: each prompt is
+    a short random seed plus a prefix of the model's OWN greedy
+    continuation — the prompt-lookup setting (extraction, templated
+    completion, code) where the text the model is about to emit repeats
+    n-grams already present in its context. Candidate texts are scored
+    by simulated drafting acceptance (``_simulate_ngram_serving`` —
+    greedy decode is deterministic, so the score is exact) and the most
+    REPETITIVE continuations are kept: this sub-workload measures the
+    text class n-gram drafting targets, the way the shared-prefix
+    workload measures common-system-prompt traffic. Random-weight tiny
+    models write noisier text than trained ones, so the selection pool
+    is a few times the request count."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from frl_distributed_ml_scaffold_tpu.models.generation import generate
+
+    cfg = model.config
+    rng = np.random.default_rng(seed + 2)
+    carry = min(32, cfg.seq_len // 8)  # continuation tokens in the prompt
+    n_new = min(max(max_new, 64), cfg.seq_len // 2)
+    scored = []
+    for _ in range(3 * n_requests):
+        s = rng.integers(0, cfg.vocab_size, size=int(rng.integers(4, 9)))
+        full = np.asarray(
+            generate(
+                model, params, jnp.asarray(s.astype(np.int32))[None],
+                max_new_tokens=carry + n_new, temperature=0.0,
+            )
+        )[0].astype(np.int32)
+        prompt = full[: s.size + carry]
+        budget = min(n_new, cfg.seq_len - prompt.size)
+        cont = full[prompt.size : prompt.size + budget]
+        tokens, verifies = _simulate_ngram_serving(prompt, cont, k)
+        scored.append((tokens / max(verifies, 1), prompt, budget))
+    scored.sort(key=lambda t: -t[0])
+    return [(p, b) for _, p, b in scored[:n_requests]]
+
+
+def _spec_pass(model, run_params, args, kv_kwargs, draft_kwargs) -> dict:
+    """The speculative headline, measured (ISSUE 11 acceptance): serve
+    the repetitive-text workload through the spec engine AND through a
+    speculate=off paged engine, and report mean accepted tokens per
+    verify step plus the target-model decode-invocations-per-token
+    reduction. Both engines follow the warm-up discipline; outputs are
+    token-identical by the greedy-acceptance contract (pinned in
+    tests/test_serving.py), so this sub-dict is pure perf."""
+    from frl_distributed_ml_scaffold_tpu.serving import ServingEngine
+
+    work = _spec_workload(
+        model, run_params, max(4, args.slots), args.max_new, args.seed,
+        k=kv_kwargs.get("speculate_k", 4),
+    )
+
+    def serve(spec: bool):
+        kw = dict(kv_kwargs)
+        dk = dict(draft_kwargs) if spec else {}
+        if not spec:
+            kw.pop("speculate", None)
+            kw.pop("speculate_k", None)
+        eng = ServingEngine(
+            model, run_params, num_slots=args.slots, temperature=0.0,
+            **kw, **dk,
+        )
+        for prompt, n_new in work:  # warm pass: compiles
+            eng.submit(prompt, n_new)
+        eng.run()
+        eng.reset_cache()
+        for prompt, n_new in work:  # measured pass
+            eng.submit(prompt, n_new)
+        done = eng.run()
+        eng.close()
+        assert len(done) == len(work), (len(done), len(work))
+        return eng, done
+
+    eng, done = serve(spec=True)
+    eng_off, _ = serve(spec=False)
+    s = eng.stats
+    verifies = max(int(s["spec_slot_verifies"]), 1)
+    inv = s["slot_steps"] / max(s["step_tokens"], 1)
+    inv_off = eng_off.stats["slot_steps"] / max(
+        eng_off.stats["step_tokens"], 1
+    )
+    return {
+        "mode": kv_kwargs.get("speculate", "ngram"),
+        "k": kv_kwargs.get("speculate_k", 0),
+        "requests": len(work),
+        "tokens": int(s["step_tokens"]),
+        "proposed": int(s["spec_proposed"]),
+        "accepted": int(s["spec_accepted"]),
+        "acceptance_rate": round(
+            s["spec_accepted"] / max(s["spec_proposed"], 1), 4
+        ),
+        "mean_accepted_per_verify": round(s["spec_emitted"] / verifies, 4),
+        "verify_steps": int(s["decode_verify"]),
+        "decode_invocations_per_token": round(inv, 4),
+        "off_decode_invocations_per_token": round(inv_off, 4),
+        "invocations_reduction_x": round(inv_off / max(inv, 1e-9), 4),
+        "per_request_accept_rate_mean": round(
+            sum(c.spec_accept_rate for c in done) / len(done), 4
+        ),
+    }
+
+
 def run_arm(model, params, arm: str, args, flops_per_token: int) -> dict:
     """One (decode impl, sharding) arm through the engine; returns the
     BENCH_TABLE-schema row."""
@@ -345,16 +540,22 @@ def run_arm(model, params, arm: str, args, flops_per_token: int) -> dict:
     suffixes = parts[2:]
     paged = "paged" in suffixes
     quants = [s for s in suffixes if s in ("int8", "fp8")]
+    spec = "spec" in suffixes
+    spec_mode = "draft" if "draft" in suffixes else "ngram"
     if (
         len(parts) < 2
         or parts[0] not in ("dense", "flash")
         or parts[1] not in ("replicated", "sharded")
         or len(quants) > 1
-        or any(s not in ("paged", "int8", "fp8") for s in suffixes)
+        or any(s not in ("paged", "int8", "fp8", "spec", "ngram", "draft")
+               for s in suffixes)
+        or (("ngram" in suffixes or "draft" in suffixes) and not spec)
+        or (spec and not paged)
     ):
         raise ValueError(
-            f"unknown arm {arm!r}: want "
-            "{dense,flash}_{replicated,sharded}[_paged][_int8|_fp8]"
+            f"unknown arm {arm!r}: want {{dense,flash}}_{{replicated,"
+            "sharded}[_paged][_int8|_fp8][_spec[_ngram|_draft]] "
+            "(spec requires paged)"
         )
     impl, sharding = parts[:2]
     quant = {"int8": "int8", "fp8": "fp8_e4m3"}[quants[0]] if quants else "none"
@@ -386,10 +587,15 @@ def run_arm(model, params, arm: str, args, flops_per_token: int) -> dict:
         dict(kv_block_size=args.block_size, kv_pool_blocks=args.pool_blocks)
         if paged else {}
     )
+    draft_kwargs = {}
+    if spec:
+        kv_kwargs.update(speculate=spec_mode, speculate_k=args.speculate_k)
+        if spec_mode == "draft":
+            draft_kwargs = _build_draft(model.config)
     with mesh_context(env):
         eng = ServingEngine(
             model, run_params, num_slots=args.slots, temperature=0.0,
-            **kv_kwargs,
+            **kv_kwargs, **draft_kwargs,
         )
         # Warm-up pass: the SAME workload once through the engine, so
         # every compiled shape the measured pass will hit (each prompt
@@ -413,7 +619,9 @@ def run_arm(model, params, arm: str, args, flops_per_token: int) -> dict:
     chaos = None
     if args.chaos:
         with mesh_context(env):
-            chaos = _chaos_pass(model, run_params, args, work, kv_kwargs)
+            chaos = _chaos_pass(
+                model, run_params, args, work, kv_kwargs, draft_kwargs
+            )
 
     # Capacity accounting (the quantized-cache arms' raison d'être):
     # actual per-slot bytes of the terminal-bucket engine cache (scale
@@ -483,6 +691,12 @@ def run_arm(model, params, arm: str, args, flops_per_token: int) -> dict:
     if paged:
         with mesh_context(env):
             prefix = _prefix_pass(model, run_params, args, kv_kwargs)
+    specd = None
+    if spec:
+        with mesh_context(env):
+            specd = _spec_pass(
+                model, run_params, args, kv_kwargs, draft_kwargs
+            )
     # SLO columns from the engine's telemetry histograms (ISSUE 7): the
     # warm-up pass's observations were dropped by reset_cache, so these
     # aggregate exactly the measured pass. TTFT is the prefill+graft
@@ -541,9 +755,22 @@ def run_arm(model, params, arm: str, args, flops_per_token: int) -> dict:
             "prefill_tokens_saved": int(
                 sum(c.prefill_tokens_saved for c in done)
             ),
+            # Speculative SLO columns (ISSUE 11; every arm — 1.0
+            # invocations/token and 0.0 accept rate when speculate=off):
+            # the per-request Completion.spec_accept_rate mean next to
+            # the slot-level decode-invocations-per-emitted-token.
+            "speculate": spec_mode if spec else "off",
+            "spec_accept_rate": round(
+                sum(c.spec_accept_rate for c in done) / len(done), 4
+            ),
+            "decode_invocations_per_token": round(
+                eng.stats["slot_steps"] / max(eng.stats["step_tokens"], 1),
+                4,
+            ),
             "engine_stats": dict(eng.stats),
             **({"paged": paged_cols} if paged_cols is not None else {}),
             **({"prefix": prefix} if prefix is not None else {}),
+            **({"spec_repetitive": specd} if specd is not None else {}),
             **({"chaos": chaos} if chaos is not None else {}),
         },
         "note": (
@@ -603,6 +830,16 @@ def main(argv=None) -> int:
                 f"prefix saved {x['prefill_tokens_saved']}/"
                 f"{x['prompt_tokens_total']} tok over "
                 f"{x['requests']} reqs ({x['unique_prefixes']} unique)",
+                file=sys.stderr,
+            )
+        if "spec_repetitive" in s:
+            sp = s["spec_repetitive"]
+            print(
+                f"# {'spec':>23s}: {sp['mode']} k={sp['k']}  "
+                f"accept {sp['acceptance_rate']:.0%}  "
+                f"{sp['mean_accepted_per_verify']:.2f} tok/verify  "
+                f"{sp['decode_invocations_per_token']:.3f} inv/tok "
+                f"({sp['invocations_reduction_x']:.2f}x fewer vs off)",
                 file=sys.stderr,
             )
         if "chaos" in s:
