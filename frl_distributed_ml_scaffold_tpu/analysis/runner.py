@@ -751,6 +751,198 @@ def lint_verify_step(
     return report
 
 
+def build_handoff_program(
+    *, seq_len: int = 96, block_size: int = 16, pool_blocks: int = 9,
+    num_slots: int = 2, prompt_tokens: int = 40, m_shared: int = 0,
+    kv_cache_quant: str = "none",
+):
+    """The prefill→decode HANDOFF SPLICE as an ABSTRACT program (ISSUE
+    12): ``(model, pool_cache, slot_cache, blk_ids, jaxpr)``, all shapes
+    eval_shape'd — nothing runs. The jaxpr is
+    ``generation.splice_pool_blocks`` — the EXACT function both the
+    colocated paged graft and the disaggregated handoff jit
+    (``ServingEngine._paged_graft_fn``), so the linted artifact and the
+    served one cannot drift. The slot cache is the contiguous prefill
+    output at the prompt's cache bucket; ``blk_ids`` are the private
+    blocks that change owner (``m_shared`` leading blocks stay put —
+    the shared-prefix case). Shared with the perf ledger's
+    ``serving:handoff`` row, like its decode/verify siblings."""
+    import jax
+    import jax.numpy as jnp
+
+    from frl_distributed_ml_scaffold_tpu.config.schema import (
+        GPTConfig,
+        PrecisionConfig,
+    )
+    from frl_distributed_ml_scaffold_tpu.models.generation import (
+        blocks_for_tokens,
+        next_cache_bucket,
+        splice_pool_blocks,
+    )
+    from frl_distributed_ml_scaffold_tpu.models.gpt import GPT
+    from frl_distributed_ml_scaffold_tpu.precision import get_policy
+
+    model = GPT(
+        GPTConfig(
+            vocab_size=64, num_layers=2, num_heads=2, hidden_dim=32,
+            seq_len=seq_len, dropout=0.0, kv_cache_quant=kv_cache_quant,
+        ),
+        get_policy(PrecisionConfig(policy="fp32")),
+    )
+    tok = jax.ShapeDtypeStruct((num_slots, 1), jnp.int32)
+    params = jax.eval_shape(
+        lambda: model.init(
+            {"params": jax.random.key(0)},
+            jnp.zeros((num_slots, 4), jnp.int32),
+            train=False,
+        )["params"]
+    )
+    mp = model.clone(kv_block_size=block_size, kv_pool_blocks=pool_blocks)
+    _, pool_vars = jax.eval_shape(
+        lambda p, t: mp.apply(
+            {"params": p}, t, decode=True, mutable=["cache"]
+        ),
+        params, tok,
+    )
+    pool_cache = pool_vars["cache"]
+    s_c = next_cache_bucket(seq_len, prompt_tokens, floor=block_size)
+    mc = model.clone(cache_len=s_c)
+    slot_tok = jax.ShapeDtypeStruct((1, 1), jnp.int32)
+    _, slot_vars = jax.eval_shape(
+        lambda p, t: mc.apply(
+            {"params": p}, t, decode=True, mutable=["cache"]
+        ),
+        params, slot_tok,
+    )
+    slot_cache = slot_vars["cache"]
+    n_priv = blocks_for_tokens(prompt_tokens, block_size) - m_shared
+    blk_ids = jax.ShapeDtypeStruct((n_priv,), jnp.int32)
+    m0 = jax.ShapeDtypeStruct((), jnp.int32)
+    slot = jax.ShapeDtypeStruct((), jnp.int32)
+
+    import functools
+
+    jaxpr = jax.make_jaxpr(
+        functools.partial(splice_pool_blocks, block_size=block_size)
+    )(pool_cache, slot_cache, blk_ids, m0, slot)
+    return model, pool_cache, slot_cache, blk_ids, jaxpr
+
+
+def lint_handoff(
+    *, seq_len: int = 96, block_size: int = 16, pool_blocks: int = 9,
+    num_slots: int = 2, prompt_tokens: int = 40,
+) -> Report:
+    """Lint the prefill→decode HANDOFF splice (ISSUE 12) — the mutation
+    gate behind the disaggregated engine's zero-logical-cache-copy
+    claim, three teeth:
+
+    - ZERO collectives: the splice is a scatter of owned blocks plus a
+      host-side table-row write — any collective in its jaxpr means the
+      handoff started resharding (the compiled-HLO reshard-free pin
+      lives in tests/test_serving.py under a live model mesh);
+    - no full-``seq_len`` intermediate and a materialization budget of
+      ONE pool leaf (the donated in-place update): a gather-based
+      handoff — materialize the logical cache view, rewrite the pool —
+      has to exceed the budget and trips it;
+    - donation audit: the engine's splice program donates the pool, or
+      every handoff holds two pools live.
+
+    Mutation-gated in tests/test_graft_lint.py (a gather-based handoff
+    mutant must trip)."""
+    import jax
+    import jax.numpy as jnp
+
+    report = Report(program="serving:handoff")
+    model, pool_cache, slot_cache, blk_ids, jaxpr = build_handoff_program(
+        seq_len=seq_len, block_size=block_size, pool_blocks=pool_blocks,
+        num_slots=num_slots, prompt_tokens=prompt_tokens,
+    )
+
+    census = collective_census(jaxpr)
+    report.meta["collective_census"] = [r.to_dict() for r in census]
+    table_blocks = seq_len // block_size
+    report.meta["splice_table_bytes"] = table_blocks * 4
+    for r in census:
+        report.add(
+            "reshard", "error", "handoff-collective",
+            f"handoff splice carries a {r.primitive} of "
+            f"{[list(s) for s in r.shapes]} — the splice moves only "
+            "owned blocks; any collective means the handoff is "
+            "resharding the cache",
+            primitive=r.primitive, shapes=[list(s) for s in r.shapes],
+        )
+    report.extend(
+        materialization_findings(
+            jaxpr, forbidden_dim=seq_len, label="handoff: "
+        )
+    )
+    budget = _max_pool_leaf_bytes(pool_cache)
+    report.meta["pool_leaf_bytes"] = budget
+    from frl_distributed_ml_scaffold_tpu.analysis.materialization import (
+        oversized_intermediates,
+    )
+
+    for i in oversized_intermediates(jaxpr, budget):
+        report.add(
+            "materialization", "error", "cache-copy",
+            f"handoff splice materializes {i.dtype}{list(i.shape)} "
+            f"({i.bytes} bytes > the {budget}-byte pool leaf, "
+            f"{i.primitive}) — the handoff must move only the blocks "
+            "that change owner (ownership is a table-row write), never "
+            "a logical-cache copy",
+            intermediate=i.to_dict(), budget_bytes=budget,
+        )
+
+    # Donation audit: jit the splice exactly as the engine does
+    # (``_paged_graft_fn``: same function, same donate_argnums) and
+    # lower it on the abstract trees — no engine state needed.
+    from frl_distributed_ml_scaffold_tpu.analysis.donation import (
+        args_info_donations,
+        lowered_donations,
+    )
+
+    import functools
+
+    from frl_distributed_ml_scaffold_tpu.models.generation import (
+        splice_pool_blocks,
+    )
+
+    splice_jit = jax.jit(
+        functools.partial(splice_pool_blocks, block_size=block_size),
+        donate_argnums=(0,),
+    )
+    m0 = jax.ShapeDtypeStruct((), jnp.int32)
+    slot = jax.ShapeDtypeStruct((), jnp.int32)
+    lowered = splice_jit.lower(pool_cache, slot_cache, blk_ids, m0, slot)
+    n_cache = len(jax.tree.leaves(pool_cache))
+    pairs = args_info_donations(lowered)
+    if pairs is None:
+        dons = [d.donated for d in lowered_donations(lowered.as_text())]
+        if sum(dons) < n_cache:
+            report.add(
+                "donation", "error", "cache-not-donated",
+                f"handoff splice donates {sum(dons)} args but the pool "
+                f"has {n_cache} leaves — two POOLS live per handoff",
+                donated=sum(dons), cache_leaves=n_cache,
+            )
+        return report
+    undonated = [p for p, d in pairs if p.startswith("[0][0]") and not d]
+    for p in undonated:
+        report.add(
+            "donation", "error", "cache-not-donated",
+            f"handoff splice does not donate pool leaf {p} — two POOLS "
+            "live per handoff",
+            path=p,
+        )
+    if not undonated:
+        report.add(
+            "donation", "info", "summary",
+            f"handoff splice donates all {n_cache} pool leaves; splice "
+            f"ownership cost is {table_blocks * 4} table bytes/slot",
+        )
+    return report
+
+
 def _max_pool_leaf_bytes(cache) -> int:
     """The largest block-pool leaf in a paged cache tree — the paged
     decode step's legal materialization ceiling (its biggest intermediate
@@ -976,6 +1168,10 @@ def lint_all(
         # The speculative verify step (ISSUE 11): the ONE [B, k+1]
         # compiled verify shape, same pins at tile width.
         emit(lint_verify_step())
+        # The prefill→decode handoff splice (ISSUE 12): the block-table
+        # re-own pinned clone-free — zero collectives, no logical-cache
+        # copy, pool donated.
+        emit(lint_handoff())
     if hygiene:
         emit(lint_hygiene())
     if robustness:

@@ -283,6 +283,26 @@ class ServingConfig:
     # The verify program compiles ONCE at this k (no per-k ladder);
     # slots with fewer (or zero) drafts ride the same program.
     speculate_k: int = 4
+    # Disaggregated prefill/decode serving (ISSUE 12,
+    # serving/scheduler.py): True routes serving through the
+    # prefill-worker / decode-worker split coordinated by the
+    # multi-tenant SLO scheduler (serving.build_engine dispatches on
+    # this). Requires the paged cache (kv_block_size > 0): the
+    # prefill→decode handoff is a block-table splice there, never a
+    # cache copy.
+    disaggregate: bool = False
+    # Prefill admissions the scheduler starts per decode tick: the
+    # decoupled-admission bound that keeps a prefill burst from starving
+    # running decodes — queued prefills DEFER (the burst queues up)
+    # instead of running inline ahead of the next decode step the way
+    # colocated admission does. 1 is the tail-isolation setting;
+    # raising it trades decode TPOT tails for admission throughput.
+    prefill_max_per_tick: int = 1
+    # Prefill-worker / handoff failures re-queue the request and retry
+    # up to this many times before the request resolves as a typed
+    # "error" (never hangs — the ISSUE-9 contract across the worker
+    # boundary).
+    handoff_retries: int = 2
 
 
 @dataclass(frozen=True)

@@ -71,6 +71,17 @@ KNOWN_SITES = frozenset(
         # never sheds, never hangs; tokens stay identical because
         # drafting is advisory).
         "serve.draft",
+        # serving/scheduler.py: the PREFILL WORKER dies mid-request
+        # (ISSUE 12) — the scheduler releases the pool reservation,
+        # re-queues the request at the head of its tenant queue, and
+        # retries (bounded by serving.handoff_retries, then typed
+        # "error"); the decode worker never notices.
+        "serve.prefill_worker",
+        # serving/scheduler.py: the prefill→decode HANDOFF (the
+        # block-table splice) fails (ISSUE 12) — same recovery as a
+        # prefill-worker death: release, re-queue, bounded retry. The
+        # never-hangs contract extends across the worker boundary.
+        "serve.handoff",
         # launcher/elastic.py: a membership heartbeat write raises OSError
         # (shared-FS outage) — drives the counted-retirement path.
         "elastic.heartbeat_write",

@@ -380,6 +380,51 @@ def blocks_for_tokens(tokens: int, block_size: int) -> int:
     return -(-int(tokens) // int(block_size))
 
 
+def splice_pool_blocks(cache, slot_cache, blk_ids, m0, slot, *,
+                       block_size: int):
+    """The prefill→decode HANDOFF SPLICE (ISSUE 12), over the block-pool
+    taxonomy: write one prefilled (contiguous, bucketed) slot cache's
+    PRIVATE blocks into their physical pool homes and set the slot's
+    cursor rows. ``blk_ids [n_priv]`` are the destination physical block
+    ids for the logical blocks starting at ``m0`` (shared prefix blocks
+    below ``m0`` are already in the pool and are NOT touched — only the
+    blocks that change owner move, the arXiv 2112.01075 discipline), and
+    ``slot`` is the decode-side row whose ``cache_index``/``pos_index``
+    the splice seeds.
+
+    This is the ONLY device work in a prefill→decode handoff: ownership
+    itself moves as a host-side block-table row write (a re-own, priced
+    in table bytes — the perf-ledger ``serving:handoff`` row), so the
+    logical cache is never copied and nothing here can reshard. The
+    serving engine jits this with the pool donated (``_paged_graft_fn``);
+    graft-lint's ``serving:handoff`` program lints this exact function
+    (a gather-based handoff materializing the logical cache view trips
+    its cache-copy budget)."""
+    from flax.traverse_util import flatten_dict, unflatten_dict
+
+    bs = block_size
+    n_priv = blk_ids.shape[0]
+    flat = flatten_dict(cache)
+    out = dict(flat)
+    sflat = flatten_dict(slot_cache)
+    for kp, leaf in sflat.items():
+        name = kp[-1]
+        if name in POOL_LEAF_OF:
+            pool_path = kp[:-1] + (POOL_LEAF_OF[name],)
+            pool = out[pool_path]
+            n_blk = leaf.shape[2] // bs
+            chunks = leaf[:, 0].reshape(
+                (leaf.shape[0], n_blk, bs) + leaf.shape[3:]
+            )
+            sl = jax.lax.dynamic_slice_in_dim(chunks, m0, n_priv, axis=1)
+            out[pool_path] = pool.at[:, blk_ids].set(sl.astype(pool.dtype))
+        elif name == "cache_index":
+            out[kp] = out[kp].at[:, slot].set(leaf[:, 0])
+        elif name == "pos_index":
+            out[kp] = out[kp].at[slot].set(leaf[0])
+    return unflatten_dict(out)
+
+
 def pool_block_bytes(cache) -> int:
     """HBM bytes of ONE pool block across all layers — K/V payloads AND
     quantization-scale blocks, from the ACTUAL pool leaves (the paged

@@ -1,11 +1,15 @@
 """Decode-optimized serving subsystem (the inference counterpart of the
 training-side overlap schedules).
 
-Three layers: the fused split-KV decode kernel (ops/decode_attention.py),
+Four layers: the fused split-KV decode kernel (ops/decode_attention.py),
 the model-sharded KV cache the GPT decode path emits under a live
-``model`` mesh axis (models/gpt.py), and the host-side continuous-batching
-engine here — a fixed slot array with per-slot length tracking, eos
-retirement, and power-of-two cache buckets (serving/engine.py).
+``model`` mesh axis (models/gpt.py), the host-side continuous-batching
+engine — a fixed slot array with per-slot length tracking, eos
+retirement, and the paged block-table KV pool (serving/engine.py) — and
+the disaggregated prefill/decode split with the multi-tenant SLO
+scheduler on top (serving/scheduler.py, ISSUE 12): prefill and decode
+workers coordinated through block-table-splice handoffs, per-tenant
+priority queues, and best-effort preemption with free park/resume.
 """
 
 from frl_distributed_ml_scaffold_tpu.serving.engine import (
@@ -15,11 +19,33 @@ from frl_distributed_ml_scaffold_tpu.serving.engine import (
     ServingEngine,
     ngram_propose,
 )
+from frl_distributed_ml_scaffold_tpu.serving.scheduler import (
+    SLO_CLASSES,
+    DisaggServingEngine,
+    PrefillWorker,
+    TenantSpec,
+)
+
+
+def build_engine(model, params, *, serving, **kw):
+    """Config-driven engine construction: dispatch on
+    ``serving.disaggregate`` (ISSUE 12) so callers holding a
+    ``ServingConfig`` get the right engine without knowing both
+    constructors. ``kw`` passes through (num_slots, eos_id, tenants,
+    prefill_env, telemetry, ...)."""
+    cls = DisaggServingEngine if serving.disaggregate else ServingEngine
+    return cls(model, params, serving=serving, **kw)
+
 
 __all__ = [
     "CacheGrowError",
     "Completion",
+    "DisaggServingEngine",
+    "PrefillWorker",
+    "SLO_CLASSES",
     "ServeRequest",
     "ServingEngine",
+    "TenantSpec",
+    "build_engine",
     "ngram_propose",
 ]

@@ -79,6 +79,7 @@ from frl_distributed_ml_scaffold_tpu.models.generation import (
     next_cache_bucket,
     pool_block_bytes,
     rewind_cache_indices,
+    splice_pool_blocks,
 )
 from frl_distributed_ml_scaffold_tpu.telemetry import (
     Histogram,
@@ -132,6 +133,70 @@ def ngram_propose(
         i = int(full[-1]) if full.size else int(hits[-1])
         return h[i + n : i + n + k].copy()
     return h[:0]
+
+
+def make_prefill_program(model, sample_kw: dict):
+    """Build THE compiled prefill program for one prompt bucket (the
+    model is already cloned to it): prefill + first-token sample. One
+    builder for both admission paths (engine jit caches and the
+    disaggregated ``PrefillWorker``'s), like ``prefill_request`` — a
+    change here (donation, sampling) lands on both or neither."""
+    kw = dict(sample_kw)
+
+    def fn(params, prompt, lengths, rng):
+        logits, cache = _prefill(model, params, prompt, lengths)
+        return _sample(logits, rng, **kw), cache
+
+    return jax.jit(fn)
+
+
+def make_seeded_prefill_program(model, sample_kw: dict):
+    """The shared-prefix variant: suffix prefill against a seeded slot
+    cache (donated — the seed is single-use by construction)."""
+    kw = dict(sample_kw)
+
+    def fn(params, prompt, lengths, rng, cache0):
+        logits, cache = _prefill(model, params, prompt, lengths, cache=cache0)
+        return _sample(logits, rng, **kw), cache
+
+    return jax.jit(fn, donate_argnums=(4,))
+
+
+def prefill_request(
+    req, res, rng, *, block_size: int, bucket_for, params,
+    prefill_fn, seeded_fn, seed_cache=None,
+):
+    """THE admission prefill recipe, in one place (ISSUE 12): bucket the
+    (possibly prefix-stripped) prompt, left-pad the suffix, and run the
+    seeded or plain prefill program. Shared by the colocated engine
+    (``_prefill_package``) and the disaggregated ``PrefillWorker`` —
+    same recipe, different params/jit-caches/partition — so the two
+    admission paths cannot drift. Returns the un-fetched package
+    ``(tok, slot_cache, s_p, s_c, m, l_suf)``; ``l_suf >= 1`` by the
+    ``_match_prefix`` cap (at least one token always prefills)."""
+    l = int(req.prompt.size)
+    m = res["m"] if res is not None else 0
+    l_suf = l - m * block_size
+    s_p = bucket_for(l_suf)
+    s_c = bucket_for(l) if block_size else s_p
+    prompt = np.zeros((1, s_p), np.int32)
+    prompt[0, s_p - l_suf :] = req.prompt[m * block_size :]  # left-pad
+    if m > 0:
+        tok, slot_cache = seeded_fn(s_p, s_c)(
+            params,
+            jnp.asarray(prompt),
+            jnp.asarray([l_suf], jnp.int32),
+            rng,
+            seed_cache,
+        )
+    else:
+        tok, slot_cache = prefill_fn(s_p)(
+            params,
+            jnp.asarray(prompt),
+            jnp.asarray([l], jnp.int32),
+            rng,
+        )
+    return tok, slot_cache, s_p, s_c, m, l_suf
 
 
 class CacheGrowError(RuntimeError):
@@ -204,6 +269,17 @@ class Completion:
     # serve_spec_{proposed,accepted}_total counters, the same path as
     # prefix_cache_hit above.
     spec_accept_rate: float = 0.0
+    # Token ARRIVAL times (ISSUE 12), seconds from submit, one per
+    # generated token: the honest inter-token-gap record — unlike
+    # ``token_latencies_s`` (the decode PROGRAM's wall time), gaps
+    # between consecutive arrivals include everything the engine did in
+    # between (inline prefills, grafts, handoffs), which is exactly the
+    # decode-TPOT-under-prefill-burst number the disaggregation A/B
+    # measures and the scheduler's per-tenant TPOT histograms observe.
+    token_times_s: list[float] = dataclasses.field(default_factory=list)
+    # Multi-tenant attribution (ISSUE 12): the tenant the request was
+    # submitted under ("" on a plain single-tenant engine).
+    tenant: str = ""
 
     @property
     def ok(self) -> bool:
@@ -353,6 +429,10 @@ class ServingEngine:
                 [] for _ in range(self.num_slots)
             ]
             self._slot_future = np.zeros(self.num_slots, np.int64)
+            # Blocks owned by PARKED requests (ISSUE 12), keyed by
+            # request id: out of any slot but still refcounted — the
+            # pool-demand accounting must keep seeing them.
+            self._parked_held: dict[int, list[int]] = {}
             self._slot_prefix_hit = np.zeros(self.num_slots, bool)
             self._slot_tokens_saved = np.zeros(self.num_slots, np.int64)
             self._tables = np.zeros(
@@ -448,6 +528,12 @@ class ServingEngine:
         # here until the next step()/run() returns them — a faulted
         # request always resolves, never hangs.
         self._early: list[Completion] = []
+        # Completions retired since the last step() drain. PERSISTENT
+        # (not rebound per step): disaggregated admission (ISSUE 12,
+        # admit_handoff) retires 1-token-budget requests BETWEEN steps,
+        # and a per-step rebind would silently drop them — every retire
+        # path appends here, step() drains.
+        self._completed: list[Completion] = []
         self._next_id = 0
         self._issued_ids: set[int] = set()
         # Host-side slot state.
@@ -456,6 +542,11 @@ class ServingEngine:
         self._len = np.zeros(self.num_slots, np.int64)  # prompt+generated
         self._active = np.zeros(self.num_slots, bool)
         self._latency: list[list[float]] = [[] for _ in range(self.num_slots)]
+        # Token ARRIVAL times per slot (submit-relative) — the gap record
+        # behind Completion.token_times_s (ISSUE 12).
+        self._tok_times: list[list[float]] = [
+            [] for _ in range(self.num_slots)
+        ]
         self._last_tok = np.zeros(self.num_slots, np.int32)
 
         self.cache: Any = None
@@ -656,6 +747,34 @@ class ServingEngine:
         requests still raise here (caller bugs), but LOAD conditions
         (queue full) come back as a typed ``"shed"`` completion, so a
         client library can treat overload as data, not control flow."""
+        req = self._new_request(prompt, max_new_tokens, request_id,
+                                deadline_s=deadline_s)
+        # Bounded admission (ISSUE 9): beyond max_queue_depth QUEUED
+        # requests, shed typed instead of growing the queue without
+        # bound — active slots are not counted (they already have their
+        # memory), so the bound is exactly "work not yet started".
+        if self.max_queue_depth and len(self._queue) >= self.max_queue_depth:
+            self._m_shed.inc()
+            self._complete_unadmitted(req, "shed")
+            return req.id
+        self._queue.append(req)
+        return req.id
+
+    def _new_request(
+        self,
+        prompt,
+        max_new_tokens: int,
+        request_id: int | None = None,
+        *,
+        deadline_s: float | None = None,
+    ) -> ServeRequest:
+        """Validate + construct a traced ``ServeRequest`` (id issued,
+        trace id born, root span opened) WITHOUT enqueueing it — the
+        piece of ``submit`` the disaggregated scheduler (ISSUE 12,
+        serving/scheduler.py) shares: its per-tenant queues own the
+        enqueue/shed policy, but the request object, the id ledger, and
+        the span tree must stay THIS engine's so completions and traces
+        read identically either way."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("empty prompt")
@@ -704,16 +823,7 @@ class ServingEngine:
         # from t_submit, so it must start exactly where the root does or
         # the tree's containment invariant breaks by a few microseconds.
         req.t_submit = getattr(req.span, "t0", None) or time.perf_counter()
-        # Bounded admission (ISSUE 9): beyond max_queue_depth QUEUED
-        # requests, shed typed instead of growing the queue without
-        # bound — active slots are not counted (they already have their
-        # memory), so the bound is exactly "work not yet started".
-        if self.max_queue_depth and len(self._queue) >= self.max_queue_depth:
-            self._m_shed.inc()
-            self._complete_unadmitted(req, "shed")
-            return rid
-        self._queue.append(req)
-        return rid
+        return req
 
     def _complete_unadmitted(self, req: ServeRequest, reason: str) -> None:
         """Resolve a request that never occupied a slot (shed / expired
@@ -765,6 +875,7 @@ class ServingEngine:
             self._reserved_future = 0
             self._slot_blocks = [[] for _ in range(self.num_slots)]
             self._slot_future[:] = 0
+            self._parked_held.clear()
             self._slot_prefix_hit[:] = False
             self._slot_tokens_saved[:] = 0
             self._tables[:] = 0
@@ -843,14 +954,9 @@ class ServingEngine:
 
     def _prefill_fn(self, s_p: int):
         if s_p not in self._prefill_jit:
-            m = self._model_at(s_p)
-            kw = dict(self._sample_kw)
-
-            def fn(params, prompt, lengths, rng):
-                logits, cache = _prefill(m, params, prompt, lengths)
-                return _sample(logits, rng, **kw), cache
-
-            self._prefill_jit[s_p] = jax.jit(fn)
+            self._prefill_jit[s_p] = make_prefill_program(
+                self._model_at(s_p), self._sample_kw
+            )
         return self._prefill_jit[s_p]
 
     def _decode_fn(self, s: int):
@@ -974,17 +1080,10 @@ class ServingEngine:
         math is identical to a full-prompt prefill minus the prefix
         tokens' projection/score work (that is the prefill-once win)."""
         if (s_p, s_c) not in self._prefill_seeded_jit:
-            m = self._model_at(s_c)
-            kw = dict(self._sample_kw)
-
-            def fn(params, prompt, lengths, rng, cache0):
-                logits, cache = _prefill(
-                    m, params, prompt, lengths, cache=cache0
+            self._prefill_seeded_jit[(s_p, s_c)] = (
+                make_seeded_prefill_program(
+                    self._model_at(s_c), self._sample_kw
                 )
-                return _sample(logits, rng, **kw), cache
-
-            self._prefill_seeded_jit[(s_p, s_c)] = jax.jit(
-                fn, donate_argnums=(4,)
             )
         return self._prefill_seeded_jit[(s_p, s_c)]
 
@@ -1029,45 +1128,24 @@ class ServingEngine:
         return self._seed_jit[(s_c, m)]
 
     def _paged_graft_fn(self, s_c: int, n_priv: int):
-        """Scatter one prefilled slot cache into the pool: the ``n_priv``
-        private blocks starting at logical block ``m0`` are written to
-        the physical ids in ``blk_ids``, and the slot's cache_index /
-        pos_index rows are set — shared prefix blocks are already in the
-        pool and are NOT touched (move only the blocks that change
-        owner). The engine cache (pool) is donated like every program
-        that rebinds it; appends and growth never clone it."""
+        """The handoff SPLICE program (``generation.splice_pool_blocks``
+        — one shared artifact: the colocated admission graft, the
+        disaggregated prefill→decode handoff, and graft-lint's
+        ``serving:handoff`` program are all this function): scatter the
+        ``n_priv`` private blocks starting at logical block ``m0`` to
+        the physical ids in ``blk_ids`` and set the slot's cache_index /
+        pos_index rows — shared prefix blocks are already in the pool
+        and are NOT touched (move only the blocks that change owner).
+        The engine cache (pool) is donated like every program that
+        rebinds it; appends and growth never clone it."""
         if (s_c, n_priv) not in self._paged_graft_jit:
-            bs = self.block_size
-            n_blk = s_c // bs
-
-            def fn(cache, slot_cache, blk_ids, m0, slot):
-                from flax.traverse_util import flatten_dict, unflatten_dict
-
-                flat = flatten_dict(cache)
-                out = dict(flat)
-                sflat = flatten_dict(slot_cache)
-                for kp, leaf in sflat.items():
-                    name = kp[-1]
-                    if name in POOL_LEAF_OF:
-                        pool_path = kp[:-1] + (POOL_LEAF_OF[name],)
-                        pool = out[pool_path]
-                        chunks = leaf[:, 0].reshape(
-                            (leaf.shape[0], n_blk, bs) + leaf.shape[3:]
-                        )
-                        sl = jax.lax.dynamic_slice_in_dim(
-                            chunks, m0, n_priv, axis=1
-                        )
-                        out[pool_path] = pool.at[:, blk_ids].set(
-                            sl.astype(pool.dtype)
-                        )
-                    elif name == "cache_index":
-                        out[kp] = out[kp].at[:, slot].set(leaf[:, 0])
-                    elif name == "pos_index":
-                        out[kp] = out[kp].at[slot].set(leaf[0])
-                return unflatten_dict(out)
+            import functools
 
             self._paged_graft_jit[(s_c, n_priv)] = jax.jit(
-                fn, donate_argnums=(0,)
+                functools.partial(
+                    splice_pool_blocks, block_size=self.block_size
+                ),
+                donate_argnums=(0,),
             )
         return self._paged_graft_jit[(s_c, n_priv)]
 
@@ -1294,10 +1372,15 @@ class ServingEngine:
             per_tok = dt / len(group)
             emitted = 0
             retired = False
+            t_group = time.perf_counter() - req.t_submit
             for i, tok in enumerate(group):
                 self._tokens[slot].append(tok)
                 self._len[slot] += 1
                 self._latency[slot].append(per_tok)
+                # The group lands together — one verify program — so its
+                # tokens share one arrival time (gaps inside a group are
+                # zero; the next gap spans the next verify).
+                self._tok_times[slot].append(t_group)
                 self._m_tpot.observe(per_tok)
                 self._last_tok[slot] = tok
                 emitted += 1
@@ -1484,13 +1567,18 @@ class ServingEngine:
         self._reserved_future -= res["future"]
 
     def _note_pool_peak(self) -> None:
-        """High-watermark of pool DEMAND — blocks held by slots plus
-        worst-case reservations, with prefix sharing counted once. This
-        is what serve_bench's paged capacity column prices a concurrent
-        slot at: blocks held ONLY by the prefix cache are deliberately
-        excluded (they are evicted on demand when admission needs the
-        room, so they are a cache, not a capacity cost)."""
+        """High-watermark of pool DEMAND — blocks held by slots (and by
+        PARKED requests: preemption moves ownership out of the slot
+        array, not out of the pool) plus worst-case reservations, with
+        prefix sharing counted once. This is what serve_bench's paged
+        capacity column prices a concurrent slot at: blocks held ONLY by
+        the prefix cache are deliberately excluded (they are evicted on
+        demand when admission needs the room, so they are a cache, not a
+        capacity cost)."""
         held = {bid for blks in self._slot_blocks for bid in blks}
+        held.update(
+            bid for blks in self._parked_held.values() for bid in blks
+        )
         demand = len(held) + self._reserved_future
         if demand > self.stats["pool_peak_blocks"]:
             self.stats["pool_peak_blocks"] = demand
@@ -1602,6 +1690,77 @@ class ServingEngine:
                 if self._try_admit(slot, req, res):
                     break
 
+    def _prefill_package(self, req: ServeRequest, res: dict | None, sub):
+        """The PREFILL-WORKER half of admission (ISSUE 12): gather the
+        shared-prefix seed from the pool (when hit) and run the shared
+        prefill recipe (``prefill_request``) against this engine's own
+        programs/params. Must run under ``_trace_ctx`` with the paged
+        pool initialized; device arrays come back un-fetched so a
+        disaggregated caller can dispatch asynchronously."""
+        return prefill_request(
+            req, res, sub,
+            block_size=self.block_size if self.paged else 0,
+            bucket_for=self._bucket_for, params=self.params,
+            prefill_fn=self._prefill_fn,
+            seeded_fn=self._prefill_seeded_fn,
+            seed_cache=self._seed_for(req, res),
+        )
+
+    def _seed_for(self, req: ServeRequest, res: dict | None):
+        """The SEED half of a shared-prefix admission, in one place for
+        both admission paths (colocated ``_prefill_package`` and the
+        disaggregated scheduler): gather the matched prefix blocks from
+        the pool into a slot-cache seed — ``None`` when there is no
+        prefix hit. Must run under ``_trace_ctx`` (the pool lives on the
+        decode partition; a separate prefill partition receives the seed
+        via the scheduler's transfer)."""
+        m = res["m"] if res is not None else 0
+        if m == 0:
+            return None
+        s_c = self._bucket_for(int(req.prompt.size))
+        return self._seed_fn(s_c, m)(
+            self.cache, jnp.asarray(res["shared"], jnp.int32)
+        )
+
+    def _graft_package(
+        self, slot: int, req: ServeRequest, res: dict | None,
+        slot_cache, s_p: int, s_c: int, m: int, m0: int | None = None,
+    ) -> None:
+        """The SPLICE half of admission: move the prefilled cache into
+        the shared engine cache. Paged: the block-table splice —
+        ``generation.splice_pool_blocks`` writes only the private blocks
+        that change owner into the pool, then ownership lands as a
+        host-side table-row write (zero logical-cache copy; the handoff
+        the disaggregated scheduler rides). Bucketed: the
+        dynamic-update-slice graft. Must run under ``_trace_ctx``."""
+        l = int(req.prompt.size)
+        if self.paged:
+            n_g = blocks_for_tokens(l, self.block_size)
+            # ``m0`` is the private blocks' logical offset WITHIN the
+            # slot cache: ``m`` for a full bucketed cache, 0 when the
+            # scheduler pre-sliced the cross-partition transfer down to
+            # the private window.
+            self.cache = self._paged_graft_fn(s_c, n_g - m)(
+                self.cache,
+                slot_cache,
+                jnp.asarray(res["priv"][: n_g - m], jnp.int32),
+                jnp.int32(m if m0 is None else m0),
+                jnp.int32(slot),
+            )
+            # The re-own: ownership moves as one table-row write.
+            blocks = res["shared"] + res["priv"]
+            self._tables[slot, :] = 0
+            self._tables[slot, : len(blocks)] = blocks
+            self._tables_dirty = True
+        else:
+            if self.cache is None:
+                self.cache = self._empty_cache(slot_cache, s_p)
+                self.bucket = s_p
+            self._ensure_bucket(max(s_p, l + 1))
+            self.cache = self._graft_fn(s_p, self.bucket)(
+                self.cache, slot_cache, jnp.int32(slot)
+            )
+
     def _try_admit(
         self, slot: int, req: ServeRequest, res: dict | None = None
     ) -> bool:
@@ -1613,13 +1772,6 @@ class ServingEngine:
         cache is only rebound to outputs of successful programs, so a
         failed admission cannot corrupt live slots."""
         l = int(req.prompt.size)
-        bs = self.block_size if self.paged else 0
-        m = res["m"] if res is not None else 0
-        l_suf = l - m * bs  # >= 1 by the _match_prefix cap
-        s_p = self._bucket_for(l_suf)
-        s_c = self._bucket_for(l) if self.paged else s_p
-        prompt = np.zeros((1, s_p), np.int32)
-        prompt[0, s_p - l_suf :] = req.prompt[m * bs :]  # left-pad suffix
         prev_rng = self._rng
         self._rng, sub = jax.random.split(self._rng)
         t0 = time.perf_counter()
@@ -1634,60 +1786,18 @@ class ServingEngine:
             with self._trace_ctx():
                 if self.paged and self.cache is None:
                     self._init_paged_cache()
-                if m > 0:
-                    # Shared-prefix admission: seed a slot cache with the
-                    # shared blocks gathered from the pool, prefill only
-                    # the suffix from position m*bs.
-                    cache0 = self._seed_fn(s_c, m)(
-                        self.cache,
-                        jnp.asarray(res["shared"], jnp.int32),
-                    )
-                    tok, slot_cache = self._prefill_seeded_fn(s_p, s_c)(
-                        self.params,
-                        jnp.asarray(prompt),
-                        jnp.asarray([l_suf], jnp.int32),
-                        sub,
-                        cache0,
-                    )
-                else:
-                    tok, slot_cache = self._prefill_fn(s_p)(
-                        self.params,
-                        jnp.asarray(prompt),
-                        jnp.asarray([l], jnp.int32),
-                        sub,
-                    )
+                tok, slot_cache, s_p, s_c, m, l_suf = self._prefill_package(
+                    req, res, sub
+                )
                 t_graft = time.perf_counter()
-                if self.paged:
-                    # Block graft: write the private prefilled blocks
-                    # (logical m..ceil(l/bs)-1) into their pool homes +
-                    # the slot's index rows — never a cache clone, never
-                    # a shared block.
-                    n_g = blocks_for_tokens(l, bs)
-                    self.cache = self._paged_graft_fn(s_c, n_g - m)(
-                        self.cache,
-                        slot_cache,
-                        jnp.asarray(res["priv"][: n_g - m], jnp.int32),
-                        jnp.int32(m),
-                        jnp.int32(slot),
-                    )
-                    blocks = res["shared"] + res["priv"]
-                    self._tables[slot, :] = 0
-                    self._tables[slot, : len(blocks)] = blocks
-                    self._tables_dirty = True
-                else:
-                    if self.cache is None:
-                        self.cache = self._empty_cache(slot_cache, s_p)
-                        self.bucket = s_p
-                    self._ensure_bucket(max(s_p, l + 1))
-                    self.cache = self._graft_fn(s_p, self.bucket)(
-                        self.cache, slot_cache, jnp.int32(slot)
-                    )
+                self._graft_package(slot, req, res, slot_cache, s_p, s_c, m)
                 self._phase(
                     "graft", t0=t_graft,
                     dur_s=time.perf_counter() - t_graft,
                     trace=req.trace, parent=req.span,
                     slot=slot, bucket=self.bucket,
-                    **({"blocks": n_g - m, "shared": m} if self.paged
+                    **({"blocks": blocks_for_tokens(l, self.block_size) - m,
+                        "shared": m} if self.paged
                        else {}),
                 )
             tok = int(jax.device_get(tok)[0])
@@ -1715,7 +1825,23 @@ class ServingEngine:
             )
             self._complete_unadmitted(req, "error")
             return False
-        dt = time.perf_counter() - t0
+        self._finish_admit(
+            slot, req, res, tok,
+            t0=t0, dt=time.perf_counter() - t0, s_p=s_p, m=m, l_suf=l_suf,
+        )
+        return True
+
+    def _finish_admit(
+        self, slot: int, req: ServeRequest, res: dict | None, tok: int,
+        *, t0: float, dt: float, s_p: int, m: int, l_suf: int,
+    ) -> None:
+        """Admission bookkeeping shared by the colocated path
+        (``_try_admit``) and the disaggregated handoff
+        (``admit_handoff``): stats, SLO observations, prefix publication,
+        and slot activation. ``dt`` is the TTFT this engine charges the
+        request (prefill + splice, however they were scheduled)."""
+        l = int(req.prompt.size)
+        bs = self.block_size if self.paged else 0
         self.stats[f"prefill_{s_p}"] += 1
         self.stats["admitted"] += 1
         self.stats["prefill_tokens"] += l_suf
@@ -1758,13 +1884,192 @@ class ServingEngine:
         self._len[slot] = l + 1
         self._active[slot] = True
         self._latency[slot] = [dt]
+        self._tok_times[slot] = [time.perf_counter() - req.t_submit]
         self._last_tok[slot] = tok
         self._slot_spec_degraded[slot] = False
         self._slot_spec_proposed[slot] = 0
         self._slot_spec_accepted[slot] = 0
         # The first sampled token can already finish the request.
         self._finishes(slot, tok)
-        return True
+
+    # ------------------------------------------- disaggregated entry points
+
+    def admit_handoff(
+        self, slot: int, req: ServeRequest, res: dict,
+        slot_cache, tok: int, *, m: int, prefill_s: float,
+        sliced: bool = False,
+    ) -> None:
+        """DECODE-WORKER admission of a prefill-worker package (ISSUE
+        12): splice the package's private blocks into the pool —
+        ``generation.splice_pool_blocks``, the same program colocated
+        admission jits, so the two paths cannot drift — and activate the
+        slot. ``prefill_s`` is the prefill worker's wall time, folded
+        into the request's TTFT. Raises on splice failure: the scheduler
+        RE-QUEUES the request (quarantine is the colocated admission
+        contract; re-queue is the disaggregated one — the prefill can be
+        retried on a healthy worker), and the engine state is untouched
+        because the pool is only rebound to a successful program's
+        output and the table/slot bookkeeping runs after it."""
+        assert self.paged, "handoff admission is a paged-engine contract"
+        assert not self._active[slot], f"slot {slot} is occupied"
+        l = int(req.prompt.size)
+        bs = self.block_size
+        s_c = self._bucket_for(l)
+        t0 = time.perf_counter()
+        with self._trace_ctx():
+            if self.cache is None:
+                self._init_paged_cache()
+            self._graft_package(
+                slot, req, res, slot_cache, self._bucket_for(l - m * bs),
+                s_c, m, m0=0 if sliced else None,
+            )
+        dt_splice = time.perf_counter() - t0
+        self.stats["handoff_splices"] += 1
+        self._phase(
+            "handoff", t0=t0, dur_s=dt_splice, trace=req.trace,
+            parent=req.span, slot=slot,
+            blocks=blocks_for_tokens(l, bs) - m, shared=m,
+        )
+        # The prefill span must END now, not prefill_s in the future:
+        # the prefill ran on the worker BEFORE the splice, so the span's
+        # honest interval is [splice_start - prefill_s, now] (it may
+        # overlap other requests' spans — concurrent prefill is the
+        # point of the split).
+        self._finish_admit(
+            slot, req, res, tok,
+            t0=t0 - prefill_s, dt=prefill_s + dt_splice,
+            s_p=self._bucket_for(l - m * bs), m=m, l_suf=l - m * bs,
+        )
+
+    def park_slot(self, slot: int) -> dict:
+        """Preemption PARK (ISSUE 12): deactivate ``slot`` while its
+        request keeps owning its KV blocks — ZERO device work (the paged
+        pool is what makes parking free: the row's table points back at
+        the trash block, the physical blocks stay referenced by the
+        parked request, and the worst-case reservation stays accounted so
+        the resumed request's appends still can never fail). Returns the
+        opaque parked state ``resume_parked`` restores."""
+        assert self.paged, "parking is a paged-engine contract"
+        assert self._active[slot], f"slot {slot} has nothing to park"
+        parked = {
+            "req": self._req[slot],
+            "tokens": self._tokens[slot],
+            "len": int(self._len[slot]),
+            "last_tok": int(self._last_tok[slot]),
+            "latency": self._latency[slot],
+            "tok_times": self._tok_times[slot],
+            "blocks": self._slot_blocks[slot],
+            "future": int(self._slot_future[slot]),
+            "prefix_hit": bool(self._slot_prefix_hit[slot]),
+            "tokens_saved": int(self._slot_tokens_saved[slot]),
+            "spec": (
+                bool(self._slot_spec_degraded[slot]),
+                int(self._slot_spec_proposed[slot]),
+                int(self._slot_spec_accepted[slot]),
+            ),
+        }
+        self._req[slot] = None
+        self._active[slot] = False
+        self._tokens[slot] = []
+        self._latency[slot] = []
+        self._tok_times[slot] = []
+        self._len[slot] = 0
+        self._slot_blocks[slot] = []
+        self._slot_future[slot] = 0
+        self._parked_held[parked["req"].id] = parked["blocks"]
+        self._tables[slot, :] = 0
+        self._tables_dirty = True
+        self.stats["parked"] += 1
+        req = parked["req"]
+        self._phase(
+            "park", t0=time.perf_counter(), dur_s=0.0,
+            trace=req.trace, parent=req.span, slot=slot,
+            n_tokens=len(parked["tokens"]),
+        )
+        return parked
+
+    def resume_parked(self, parked: dict, slot: int) -> None:
+        """Preemption RESUME: re-own the parked block table into ``slot``
+        (a table-row write) and restore the row's device cursors with one
+        pointer-move program (``rewind_cache_indices`` — the speculation
+        rollback reused: active rows already sit at ``len - 1``, the
+        engine invariant, so the move only touches the resumed row). The
+        request then continues decoding from its parked ``last_tok``,
+        token-identically — nothing about its K/V ever moved."""
+        assert self.paged and not self._active[slot]
+        req = parked["req"]
+        self._req[slot] = req
+        self._tokens[slot] = parked["tokens"]
+        self._len[slot] = parked["len"]
+        self._last_tok[slot] = parked["last_tok"]
+        self._latency[slot] = parked["latency"]
+        self._tok_times[slot] = parked["tok_times"]
+        self._slot_blocks[slot] = parked["blocks"]
+        self._slot_future[slot] = parked["future"]
+        self._slot_prefix_hit[slot] = parked["prefix_hit"]
+        self._slot_tokens_saved[slot] = parked["tokens_saved"]
+        (self._slot_spec_degraded[slot], self._slot_spec_proposed[slot],
+         self._slot_spec_accepted[slot]) = parked["spec"]
+        self._parked_held.pop(req.id, None)
+        self._active[slot] = True
+        self._tables[slot, :] = 0
+        self._tables[slot, : len(parked["blocks"])] = parked["blocks"]
+        self._tables_dirty = True
+        new_idx = np.where(self._active, self._len - 1, 0).astype(np.int32)
+        with self._trace_ctx():
+            self.cache = self._rewind_fn()(self.cache, jnp.asarray(new_idx))
+        self.stats["resumed"] += 1
+        self._phase(
+            "resume", t0=time.perf_counter(), dur_s=0.0,
+            trace=req.trace, parent=req.span, slot=slot,
+            n_tokens=len(parked["tokens"]),
+        )
+
+    def retire_parked(self, parked: dict, reason: str) -> None:
+        """Resolve a PARKED request without resuming it (ISSUE 12 —
+        today's caller: the scheduler's parked-deadline sweep): build
+        the typed completion carrying the tokens generated before the
+        park, release the request's blocks and worst-case reservation,
+        and close the span. Needs no slot and no device work — the
+        parked K/V are simply abandoned."""
+        assert self.paged, "parking is a paged-engine contract"
+        req = parked["req"]
+        lat = parked["latency"]
+        tpot = _log2_quantiles(lat[1:], (0.50, 0.99))
+        comp = Completion(
+            id=req.id,
+            tokens=np.concatenate(
+                [req.prompt, np.asarray(parked["tokens"], np.int32)]
+            ),
+            prompt_len=int(req.prompt.size),
+            finish_reason=reason,
+            token_latencies_s=lat,
+            ttft_s=lat[0] if lat else 0.0,
+            tpot_p50_s=tpot[0],
+            tpot_p99_s=tpot[1],
+            prefix_cache_hit=parked["prefix_hit"],
+            prefill_tokens_saved=parked["tokens_saved"],
+            spec_accept_rate=(
+                parked["spec"][2] / parked["spec"][1]
+                if parked["spec"][1] else 0.0
+            ),
+            token_times_s=parked["tok_times"],
+        )
+        self._completed.append(comp)
+        for bid in parked["blocks"]:
+            self._deref(bid)
+        self._reserved_future -= parked["future"]
+        self._parked_held.pop(req.id, None)
+        self._m_pool_util.set(self.pool_utilization())
+        self.stats["completed"] += 1
+        self.stats[f"finish_{reason}"] += 1
+        self._m_completed.inc()
+        self._phase(
+            "retire", t0=time.perf_counter(), dur_s=0.0,
+            trace=req.trace, parent=req.span,
+            request=req.id, reason=reason, n_tokens=len(parked["tokens"]),
+        )
+        req.span.end(finish_reason=reason, n_tokens=len(parked["tokens"]))
 
     def _finishes(self, slot: int, tok: int) -> bool:
         req = self._req[slot]
@@ -1805,6 +2110,7 @@ class ServingEngine:
                 / float(self._slot_spec_proposed[slot])
                 if self._slot_spec_proposed[slot] else 0.0
             ),
+            token_times_s=self._tok_times[slot],
         )
         self._completed.append(comp)
         self._req[slot] = None
@@ -1838,12 +2144,16 @@ class ServingEngine:
 
     # --------------------------------------------------------------- step
 
+    def _drain_completed(self) -> list[Completion]:
+        out = self._completed
+        self._completed = []
+        return out
+
     def step(self) -> list[Completion]:
         """Admit into free slots, run ONE decode iteration over the slot
         array, retire finished rows. Returns requests completed during
         this step (possibly at admission, for 1-token budgets; typed
         shed/deadline/error resolutions ride along)."""
-        self._completed: list[Completion] = []
         self._m_queue.set(len(self._queue))
         self._admit()
         # Typed completions resolved since the last step (shed at
@@ -1852,7 +2162,7 @@ class ServingEngine:
         self._early.clear()
         self._m_occupancy.set(float(self._active.sum()) / self.num_slots)
         if not self._active.any():
-            return self._completed
+            return self._drain_completed()
 
         # Speculative proposal round (ISSUE 11): drafts per slot for
         # this step's verify tile — BEFORE the block-append loop, which
@@ -1918,7 +2228,7 @@ class ServingEngine:
                     )
             self._m_pool_util.set(self.pool_utilization())
             if not self._active.any():
-                return self._completed
+                return self._drain_completed()
             if self._tables_dirty:
                 self.cache = {
                     **self.cache,
@@ -1930,7 +2240,7 @@ class ServingEngine:
                 # the ONE verify program (slots without drafts
                 # single-step inside it — the mixed-batch contract).
                 self._spec_verify(drafts)
-                return self._completed
+                return self._drain_completed()
         else:
             # Bucket must hold every active row's next write position: an
             # active row holds cache_index == _len - 1 (prefill sets idx=l
@@ -1960,7 +2270,7 @@ class ServingEngine:
                 for s in victims:
                     self._retire(int(s), "error")
                 if not self._active.any():
-                    return self._completed
+                    return self._drain_completed()
 
         self._rng, sub = jax.random.split(self._rng)
         t0 = time.perf_counter()
@@ -2010,6 +2320,9 @@ class ServingEngine:
             self._tokens[slot].append(tok)
             self._len[slot] += 1
             self._latency[slot].append(dt)
+            self._tok_times[slot].append(
+                time.perf_counter() - req.t_submit
+            )
             self._m_tpot.observe(dt)
             self._last_tok[slot] = tok
             # ...and one request-lane tick per live row, sharing the
@@ -2030,4 +2343,4 @@ class ServingEngine:
             if self._expired(req):
                 self._m_deadline.inc()
                 self._retire(slot, "deadline")
-        return self._completed
+        return self._drain_completed()

@@ -883,3 +883,157 @@ def test_serve_bench_chaos_arm_reports_rates(capsys):
         + c["completed_ok"]
     )
     assert total == c["requests"], c  # every request resolved, typed
+
+
+@pytest.mark.serving
+def test_prefill_worker_death_requeues_and_completes_identically(gpt):
+    """ISSUE 12 fault-matrix row, ``serve.prefill_worker``: the prefill
+    worker dying mid-request re-queues it at the head of its tenant
+    queue (typed, counted — worker failures + requeue stat) and the
+    retry completes TOKEN-IDENTICALLY; the decode worker's running slots
+    never notice. The never-hangs contract extends across the worker
+    boundary: every submitted id resolves exactly once."""
+    from frl_distributed_ml_scaffold_tpu.serving import DisaggServingEngine
+
+    model, params = gpt
+    eng = DisaggServingEngine(
+        model, params, num_slots=2, temperature=0.0, kv_block_size=8
+    )
+    pa, pb = np.arange(5, dtype=np.int32), np.arange(6, dtype=np.int32)
+    with faults.active(
+        FaultPlan([dict(site="serve.prefill_worker", at=1, times=1)])
+    ) as plan:
+        ra = eng.submit(pa, 5)
+        rb = eng.submit(pb, 4)
+        done = {c.id: c for c in eng.run()}
+    assert plan.injected == {"serve.prefill_worker": 1}
+    assert done[ra].ok and done[rb].ok
+    np.testing.assert_array_equal(done[ra].tokens, _solo(model, params, pa, 5))
+    np.testing.assert_array_equal(done[rb].tokens, _solo(model, params, pb, 4))
+    t = eng.telemetry
+    assert t.counter("serve_prefill_worker_failures_total").value == 1
+    assert eng.stats["prefill_worker_requeued"] == 1
+    assert eng.stats["handoff_requeued"] == 0
+    eng.close()
+
+
+@pytest.mark.serving
+def test_handoff_failure_retries_then_resolves_typed_error(gpt):
+    """ISSUE 12 fault-matrix row, ``serve.handoff``: a single splice
+    failure re-queues and recovers token-identically; a PERSISTENT
+    failure exhausts ``handoff_retries`` and resolves as a typed
+    "error" completion — counted at every attempt, never a hang, and
+    the pool reservation is released each time (no block leak: a
+    healthy request admits afterwards)."""
+    from frl_distributed_ml_scaffold_tpu.serving import DisaggServingEngine
+
+    model, params = gpt
+    p = np.arange(5, dtype=np.int32)
+
+    eng = DisaggServingEngine(
+        model, params, num_slots=2, temperature=0.0, kv_block_size=8
+    )
+    with faults.active(
+        FaultPlan([dict(site="serve.handoff", at=1, times=1)])
+    ):
+        rid = eng.submit(p, 5)
+        done = {c.id: c for c in eng.run()}
+    assert done[rid].ok
+    np.testing.assert_array_equal(done[rid].tokens, _solo(model, params, p, 5))
+    assert eng.telemetry.counter("serve_handoff_failures_total").value == 1
+
+    with faults.active(FaultPlan([dict(site="serve.handoff", times=0)])):
+        rid2 = eng.submit((p + 1) % 64, 4)
+        done2 = {c.id: c for c in eng.run()}
+    assert done2[rid2].finish_reason == "error"
+    # 1 (recovered above) + initial + handoff_retries retries.
+    assert (
+        eng.telemetry.counter("serve_handoff_failures_total").value
+        == 1 + 1 + eng.handoff_retries
+    )
+    # No block leak: the released reservations admit a healthy request.
+    rid3 = eng.submit(p, 4)
+    done3 = {c.id: c for c in eng.run()}
+    assert done3[rid3].ok
+    np.testing.assert_array_equal(done3[rid3].tokens, _solo(model, params, p, 4))
+    eng.close()
+
+
+@pytest.mark.serving
+def test_serve_bench_disagg_chaos_reports_requeues(capsys):
+    """serve_bench --chaos on the ``*_disagg`` arm: the worker-boundary
+    injections (one prefill-worker death, one handoff failure) are
+    reported next to the recovery proof — both re-queued, every burst
+    request resolved."""
+    import json as _json
+
+    sys_path_mod = __import__("sys")
+    import os as _os
+
+    tools = _os.path.join(
+        _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))),
+        "tools",
+    )
+    if tools not in sys_path_mod.path:
+        sys_path_mod.path.insert(0, tools)
+    import serve_bench
+
+    rc = serve_bench.main(
+        [
+            "--preset", "tiny", "--requests", "4", "--slots", "2",
+            "--max-new", "4", "--sim-devices", "0",
+            "--arms", "flash_replicated_paged_disagg", "--chaos",
+        ]
+    )
+    assert rc == 0
+    lines = [
+        l for l in capsys.readouterr().out.splitlines() if l.startswith("{")
+    ]
+    assert len(lines) == 1
+    d = _json.loads(lines[0])["serving"]["disagg"]
+    c = d["chaos"]
+    assert c["injected"] == {
+        "serve.prefill_worker": 1, "serve.handoff": 1
+    }
+    assert c["prefill_worker_failures"] == 1
+    assert c["handoff_failures"] == 1
+    assert c["requeued"] == 2
+    assert c["completed"] == d["decode_requests"] + d["burst_requests"]
+    assert c["completed_ok"] == c["completed"]
+
+
+@pytest.mark.serving
+def test_worker_failure_is_rng_neutral_for_sampled_decode(gpt):
+    """The disaggregated analog of quarantine rng-neutrality: a
+    prefill-worker failure re-queues the request and the RETRY reuses
+    the failed attempt's RNG split, so sampled (temperature>0) output —
+    the faulted request's AND every later request's — is identical to a
+    fault-free run of the same engine."""
+    from frl_distributed_ml_scaffold_tpu.serving import DisaggServingEngine
+
+    model, params = gpt
+    pa, pb = np.arange(5, dtype=np.int32), np.arange(6, dtype=np.int32)
+
+    def serve(plan):
+        eng = DisaggServingEngine(
+            model, params, num_slots=2, temperature=0.7, kv_block_size=8
+        )
+        ctx = faults.active(plan) if plan else None
+        if ctx:
+            with ctx:
+                ra = eng.submit(pa, 6)
+                rb = eng.submit(pb, 4)
+                done = {c.id: c for c in eng.run()}
+        else:
+            ra = eng.submit(pa, 6)
+            rb = eng.submit(pb, 4)
+            done = {c.id: c for c in eng.run()}
+        eng.close()
+        return done[ra].tokens, done[rb].tokens
+
+    ref_a, ref_b = serve(None)
+    got_a, got_b = serve(
+        FaultPlan([dict(site="serve.prefill_worker", at=1, times=1)])
+    )
+    np.testing.assert_array_equal(got_a, ref_a)
+    np.testing.assert_array_equal(got_b, ref_b)

@@ -575,6 +575,63 @@ def test_verify_step_lint_clean_and_mutations_trip():
     assert not any(d for _, d in d_pairs), "dropped donation still marked"
 
 
+def test_handoff_lint_clean_and_gather_mutation_trips():
+    """ISSUE 12's gates on the prefill→decode handoff splice: the
+    shipped splice (``generation.splice_pool_blocks`` — the exact
+    function the engine jits for both colocated grafts and
+    disaggregated handoffs) passes all three teeth (ZERO collectives,
+    no full-seq_len materialization, nothing bigger than one pool leaf,
+    pool donated), and the canonical regression trips — a GATHER-BASED
+    handoff that materializes the logical cache view (``pool[tables]``
+    contiguous) and rewrites the pool is exactly the cache copy the
+    block-table splice exists to delete."""
+    import jax.numpy as jnp
+
+    from frl_distributed_ml_scaffold_tpu.analysis.materialization import (
+        oversized_intermediates,
+    )
+    from frl_distributed_ml_scaffold_tpu.analysis.runner import (
+        _max_pool_leaf_bytes,
+        build_handoff_program,
+        lint_handoff,
+    )
+
+    rep = lint_handoff()
+    assert rep.ok, [f.message for f in rep.errors()]
+    assert rep.meta["collective_census"] == [], "splice grew a collective"
+    assert rep.meta["pool_leaf_bytes"] > 0
+    # The ledger's table-bytes claim: splice ownership cost is the int32
+    # table row, orders of magnitude under the pool.
+    assert rep.meta["splice_table_bytes"] * 100 < rep.meta["pool_leaf_bytes"]
+
+    model, pool_cache, slot_cache, blk_ids, jaxpr = build_handoff_program()
+    seq_len = model.config.seq_len
+    budget = _max_pool_leaf_bytes(pool_cache)
+    pins.assert_no_dim_materialized(jaxpr, seq_len)
+    pins.assert_max_materialized_bytes(jaxpr, budget)
+
+    # Mutation: the gather-based handoff — materialize the logical view,
+    # splice the slot cache into it, scatter the WHOLE pool back.
+    def gather_handoff(c, sc):
+        kp = c["blocks"]["attn"]["key_pool"]  # [L, N, bs, H, hd]
+        tbl = c["block_tables"]  # [B, M]
+        g = jnp.take(kp, tbl, axis=1)  # [L, B, M, bs, H, hd]
+        l, _, bs, h, hd = kp.shape
+        b, m = tbl.shape
+        logical = g.reshape(l, b, m * bs, h, hd)  # the full-context copy
+        sk = sc["blocks"]["attn"]["cached_key"]  # [L, 1, s_c, H, hd]
+        logical = logical.at[:, 0, : sk.shape[2]].set(sk[:, 0])
+        return logical
+
+    mut_jaxpr = jax.make_jaxpr(gather_handoff)(pool_cache, slot_cache)
+    assert oversized_intermediates(mut_jaxpr, budget), (
+        "a gather-based handoff fits under the pool-leaf budget — the "
+        "cache-copy pin has no teeth"
+    )
+    with pytest.raises(AssertionError, match=str(seq_len)):
+        pins.assert_no_dim_materialized(mut_jaxpr, seq_len)
+
+
 @pytest.mark.fast
 def test_mutation_dropped_donation_is_caught():
     """THE donation mutation gate: the same program jitted with and
@@ -942,6 +999,7 @@ def test_cli_all_recipes_runs_clean_and_emits_json(tmp_path):
         assert f"recipe:{name}" in programs, programs
     assert "serving:decode_step" in programs
     assert "serving:decode_step_int8kv" in programs
+    assert "serving:handoff" in programs
     assert "hygiene:traced-modules" in programs
     assert "robustness:package" in programs
     assert all(r["ok"] for r in reports), [

@@ -1312,3 +1312,484 @@ def test_serve_bench_spec_arm_acceptance_pin(capsys):
     # well-formed and the engine invocation ledger consistent).
     assert 0.0 <= s["spec_accept_rate"] <= 1.0
     assert 0.0 < s["decode_invocations_per_token"] <= 1.0
+
+
+# ----------------------------------------- disaggregated serving (ISSUE 12)
+
+
+from frl_distributed_ml_scaffold_tpu.serving import (  # noqa: E402
+    DisaggServingEngine,
+    TenantSpec,
+)
+
+
+def _disagg_vs_generate(model, params, bs, reqs, num_slots=2, **eng_kw):
+    """Serve ``reqs`` [(prompt, n_new, tenant)] through the
+    disaggregated scheduler and assert every completion equals its own
+    solo generate() run — the prefill-worker → splice → decode-worker
+    path cannot drift from the monolithic one."""
+    eng = DisaggServingEngine(
+        model, params, num_slots=num_slots, temperature=0.0,
+        kv_block_size=bs, **eng_kw,
+    )
+    ids = {}
+    for p, n, tenant in reqs:
+        ids[eng.submit(p, n, tenant=tenant)] = (p, n, tenant)
+    done = {c.id: c for c in eng.run()}
+    assert sorted(done) == sorted(ids), "not every request completed"
+    for rid, (prompt, n_new, tenant) in ids.items():
+        assert done[rid].tenant == tenant
+        ref = generate(
+            model, params, jnp.asarray(prompt)[None], max_new_tokens=n_new,
+            temperature=0.0,
+        )
+        np.testing.assert_array_equal(
+            done[rid].tokens, np.asarray(ref)[0],
+            err_msg=f"request {rid} diverged from its solo generate()",
+        )
+    return eng, done
+
+
+def _mixed_tenant_reqs(rng, n=6):
+    tenants = ["fg", "bg"]
+    return [
+        (rng.integers(0, 64, size=int(rng.integers(2, 12))).astype(np.int32),
+         int(rng.integers(2, 9)), tenants[i % 2])
+        for i in range(n)
+    ]
+
+
+@pytest.mark.parametrize("bs", [8, 16])
+def test_disagg_token_identical_bf16(gpt, bs):
+    """ISSUE 12 acceptance core, bf16/fp32 column: continuous batching
+    through the disaggregated prefill/decode split — every handoff a
+    block-table splice — is token-identical to generate() across block
+    sizes, under two tenants of different SLO classes. Handoffs (not
+    colocated admissions) must actually have carried every request."""
+    model, params, _ = gpt
+    rng = np.random.default_rng(41)
+    eng, done = _disagg_vs_generate(
+        model, params, bs, _mixed_tenant_reqs(rng), num_slots=3,
+        tenants=[TenantSpec("fg", "latency"),
+                 TenantSpec("bg", "best_effort")],
+    )
+    assert eng.stats["handoffs"] == len(done)
+    assert eng.stats["handoff_splices"] == len(done)
+    assert eng.stats["handoff_transfer_bytes"] == 0  # shared pool: re-own
+    assert eng.decode._reserved_future == 0
+    assert all(not b for b in eng.decode._slot_blocks)
+    eng.close()
+
+
+@pytest.mark.parametrize("bs", [8, 16])
+def test_disagg_token_identical_int8(gpt_int8, bs):
+    """The quantized column: the splice moves int8 pool blocks AND
+    their scale blocks (the PR 6 format vocabulary rides the same
+    name-keyed taxonomy), token-identical to the quantized generate()."""
+    model, params, _ = gpt_int8
+    rng = np.random.default_rng(43)
+    eng, done = _disagg_vs_generate(
+        model, params, bs, _mixed_tenant_reqs(rng), num_slots=3,
+    )
+    assert eng.stats["handoffs"] == len(done)
+    eng.close()
+
+
+def test_disagg_spec_rides_decode_worker(gpt):
+    """Speculation rides the DECODE worker unchanged: an accepting
+    prompt speculates (verify steps, accepted drafts) while admissions
+    arrive via handoff, and output stays token-identical."""
+    model, params, _ = gpt
+    rng = np.random.default_rng(47)
+    reqs = [
+        (_accepting_prompt(model, params), 14, "fg"),
+        (rng.integers(0, 64, size=9).astype(np.int32), 6, "bg"),
+        (np.arange(2, dtype=np.int32), 12, "bg"),
+    ]
+    eng, done = _disagg_vs_generate(
+        model, params, 8, reqs, num_slots=3,
+        speculate="ngram", speculate_k=4,
+        tenants=[TenantSpec("fg", "latency"),
+                 TenantSpec("bg", "best_effort")],
+    )
+    assert eng.stats["decode_verify"] > 0, dict(eng.stats)
+    assert 0 < eng.stats["spec_accepted"] <= eng.stats["spec_proposed"]
+    assert eng.stats["handoffs"] == len(done)
+    eng.close()
+
+
+def test_disagg_prefix_reuse_through_prefill_worker(gpt):
+    """Shared-prefix admissions cross the worker boundary: the seed
+    gathers from the decode worker's POOL, the prefill worker prefills
+    only the suffix, and the splice writes only the private blocks —
+    prefill work still scales with unique prefixes, token-identically."""
+    model, params, _ = gpt
+    bs = 8
+    pre = np.arange(2 * bs, dtype=np.int32) % 64  # two exact blocks
+    reqs = [
+        (np.concatenate([pre, np.asarray([7, 9], np.int32)]), 4, "fg"),
+        (np.concatenate([pre, np.asarray([11, 3, 5], np.int32)]), 4, "fg"),
+    ]
+    eng, done = _disagg_vs_generate(model, params, bs, reqs, num_slots=2)
+    assert eng.stats["prefix_hits"] == 1, dict(eng.stats)
+    assert eng.stats["prefill_tokens_saved"] == 2 * bs
+    hits = [c for c in done.values() if c.prefix_cache_hit]
+    assert len(hits) == 1 and hits[0].prefill_tokens_saved == 2 * bs
+    eng.close()
+
+
+def test_disagg_preemption_park_resume_token_identity(gpt):
+    """The SLO scheduler's preemption contract: a latency-class arrival
+    with no free slot PARKS the best-effort slot (blocks stay owned —
+    zero device work), decodes to completion, and the parked request
+    RESUMES (table re-own + one cursor pointer-move) and finishes
+    TOKEN-IDENTICALLY — nothing about its K/V ever moved."""
+    model, params, _ = gpt
+    eng = DisaggServingEngine(
+        model, params, num_slots=1, temperature=0.0, kv_block_size=8,
+        tenants=[TenantSpec("fg", "latency"),
+                 TenantSpec("bg", "best_effort")],
+    )
+    pb = np.arange(4, dtype=np.int32)
+    pf = (np.arange(5, dtype=np.int32) + 7) % 64
+    rb = eng.submit(pb, 14, tenant="bg")
+    out = []
+    for _ in range(4):  # bg decoding mid-stream when fg arrives
+        out += eng.step()
+    rf = eng.submit(pf, 4, tenant="fg")
+    done = {c.id: c for c in out + eng.run()}
+    assert eng.stats["preemptions"] == 1
+    assert eng.stats["parked"] == 1 and eng.stats["resumed"] == 1
+    assert eng.telemetry.counter("serve_preemption_total").value == 1
+    assert eng.telemetry.counter("serve_resume_total").value == 1
+    for rid, (p, n) in ((rb, (pb, 14)), (rf, (pf, 4))):
+        ref = generate(
+            model, params, jnp.asarray(p)[None], max_new_tokens=n,
+            temperature=0.0,
+        )
+        np.testing.assert_array_equal(done[rid].tokens, np.asarray(ref)[0])
+    # The preempted tenant's completion is attributed correctly and the
+    # fg request finished FIRST (that is what the preemption bought).
+    assert done[rb].tenant == "bg" and done[rf].tenant == "fg"
+    eng.close()
+
+
+@pytest.mark.fast
+def test_disagg_per_tenant_shed_ordering(gpt):
+    """SLO-ordered shedding: with the GLOBAL queue bound hit, a
+    latency-class arrival sheds the newest queued best-effort request
+    instead of itself — overload lands on the class the SLO says eats
+    it, counted per tenant."""
+    model, params, _ = gpt
+    eng = DisaggServingEngine(
+        model, params, num_slots=1, temperature=0.0, kv_block_size=8,
+        max_queue_depth=2,
+        tenants=[TenantSpec("fg", "latency"),
+                 TenantSpec("bg", "best_effort")],
+    )
+    p = np.arange(4, dtype=np.int32)
+    bg_ids = [eng.submit((p + i) % 64, 2, tenant="bg") for i in range(2)]
+    fg_id = eng.submit((p + 9) % 64, 2, tenant="fg")  # bound hit: bg pays
+    bg_shed_after = eng.submit((p + 3) % 64, 2, tenant="bg")  # self-sheds
+    done = {c.id: c for c in eng.run()}
+    assert done[fg_id].ok, "latency arrival must not shed itself"
+    assert done[bg_ids[0]].ok, "older bg request survives"
+    assert done[bg_ids[1]].finish_reason == "shed", "newest bg pays"
+    assert done[bg_shed_after].finish_reason == "shed"
+    t = eng.telemetry
+    assert t.counter("serve_shed_total_tenant_bg").value == 2
+    assert t.counter("serve_shed_total_tenant_fg").value == 0
+    assert done[bg_ids[1]].tenant == "bg"
+    eng.close()
+
+
+def test_disagg_separate_partition_transfers_only_blocks(gpt):
+    """The two-submesh instantiation (the tentpole's CPU-sim shape): the
+    prefill worker runs on its OWN 1-device submesh with its own params
+    replica, dispatches async, and the handoff moves ONLY the suffix
+    slot-cache blocks across partitions (counted) — output stays
+    token-identical."""
+    model, params, _ = gpt
+    penv = build_mesh(MeshConfig(data=1), devices=[jax.devices()[1]])
+    rng = np.random.default_rng(53)
+    eng, done = _disagg_vs_generate(
+        model, params, 8,
+        [(rng.integers(0, 64, size=int(rng.integers(2, 10)))
+          .astype(np.int32), int(rng.integers(2, 7)), "default")
+         for _ in range(4)],
+        num_slots=2, prefill_env=penv,
+    )
+    assert eng.stats["handoffs"] == len(done)
+    moved = eng.stats["handoff_transfer_bytes"]
+    assert moved > 0
+    assert (
+        eng.telemetry.counter("serve_handoff_transfer_bytes_total").value
+        == moved
+    )
+    # Far less than the logical caches: only prompt-bucket slot caches
+    # ever cross, never the pool.
+    pool_bytes = sum(
+        int(np.prod(l.shape)) * jnp.dtype(l.dtype).itemsize
+        for l in jax.tree.leaves(eng.decode.cache)
+    )
+    assert moved < pool_bytes
+    eng.close()
+
+
+def test_handoff_splice_reshard_free_compiled_hlo(gpt):
+    """ISSUE 12 acceptance pin: under a live model mesh the compiled
+    handoff splice is RESHARD-FREE — no all-gather producing an array
+    with the pool's (or the logical cache's) geometry. The head-sharded
+    pool takes the prefilled blocks in place; a gather-based handoff
+    would have to materialize one of these signatures."""
+    model, params, _ = gpt
+    env = build_mesh(MeshConfig(data=2, model=4))
+    bs, tp_m = 8, 4
+    with mesh_context(env):
+        sharded = _shard(params, env)
+        eng = ServingEngine(
+            model, sharded, num_slots=2, temperature=0.0, kv_block_size=bs,
+        )
+        rid = eng.submit(np.arange(5, dtype=np.int32), 3)
+        done = {c.id: c for c in eng.run()}
+        assert done[rid].ok
+        s_c = 8
+        mc = model.clone(cache_len=s_c)
+        tok = jnp.zeros((1, 1), jnp.int32)
+        _, vars_out = jax.jit(
+            lambda p, t: mc.apply(
+                {"params": p}, t, decode=True, mutable=["cache"]
+            ),
+        )(sharded, tok)
+        slot_cache = vars_out["cache"]
+        n_priv = 1
+        compiled = eng._paged_graft_fn(s_c, n_priv).lower(
+            eng.cache, slot_cache,
+            jnp.zeros((n_priv,), jnp.int32), jnp.int32(0), jnp.int32(0),
+        ).compile()
+    l = model.config.num_layers
+    h = model.config.num_heads
+    hd = model.config.hidden_dim // h
+    n_pool = eng.pool_blocks
+    sigs = set()
+    for hh in {h, h // tp_m}:
+        sigs.add((l, n_pool, bs, hh, hd))  # a regathered pool
+        sigs.add((n_pool, bs, hh, hd))
+        for b in (1, 2):
+            sigs.add((l, b, model.config.seq_len, hh, hd))  # logical view
+    pins.assert_reshard_free(compiled, sigs, ops=("all-gather",))
+    eng.close()
+
+
+def test_serve_bench_disagg_arm_tail_isolation_pin(capsys):
+    """THE ISSUE 12 acceptance pin: the serve_bench ``*_disagg`` arm's
+    burst A/B holds decode TPOT p99 under a prefill burst at <= 0.5x
+    the colocated arm's (>= 2x tail isolation — structurally ~(P+d) vs
+    ~(k·P+d) with k free slots churning budget-1 prefills, so the
+    margin is architectural, not a timing accident), with the handoff
+    a zero-copy re-own (0 transfer bytes) and the burst genuinely
+    deferred."""
+    import json
+
+    sys_path_mod = __import__("sys")
+    import os as _os
+
+    tools = _os.path.join(
+        _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))),
+        "tools",
+    )
+    if tools not in sys_path_mod.path:
+        sys_path_mod.path.insert(0, tools)
+    import serve_bench
+
+    rc = serve_bench.main(
+        [
+            "--preset", "tiny", "--requests", "4", "--slots", "4",
+            "--max-new", "6", "--sim-devices", "0",
+            "--arms", "flash_replicated_paged_disagg",
+        ]
+    )
+    assert rc == 0
+    lines = [
+        l for l in capsys.readouterr().out.splitlines()
+        if l.startswith("{")
+    ]
+    assert len(lines) == 1
+    s = json.loads(lines[0])["serving"]
+    assert s["disaggregated"] is True
+    assert s["engine_stats"]["handoffs"] == s["requests"]
+    d = s["disagg"]
+    # Tail isolation: disagg p99 <= 0.5x colocated p99.
+    assert d["tail_isolation_x"] >= 2.0, d
+    assert (
+        d["disagg_decode_tpot_p99_ms"]
+        <= 0.5 * d["colocated_decode_tpot_p99_ms"]
+    ), d
+    # The handoff is a block-table splice: zero cache-copy bytes moved
+    # (shared pool: ownership re-owns; the census/HLO pins live in
+    # test_graft_lint.py and test_handoff_splice_reshard_free above).
+    assert d["handoff_transfer_bytes"] == 0
+    assert d["handoffs"] == d["decode_requests"] + d["burst_requests"]
+    assert d["prefill_deferred"] > 0, "the burst was never deferred"
+    assert d["handoff_p50_ms"] > 0
+
+
+def test_disagg_expired_parked_request_retires_typed_and_frees_blocks(gpt):
+    """A parked request past its deadline must not hold its pool blocks
+    hostage: the scheduler's parked sweep retires it typed "deadline"
+    IN PLACE (no slot, no device work), carrying the tokens generated
+    before the park, and its blocks/reservation return to the pool."""
+    model, params, _ = gpt
+    eng = DisaggServingEngine(
+        model, params, num_slots=1, temperature=0.0, kv_block_size=8,
+        tenants=[TenantSpec("fg", "latency"),
+                 TenantSpec("bg", "best_effort")],
+    )
+    pb = np.arange(4, dtype=np.int32)
+    rb = eng.submit(pb, 14, tenant="bg")
+    out = []
+    for _ in range(4):
+        out += eng.step()
+    rf = eng.submit((pb + 7) % 64, 4, tenant="fg")
+    # Step until the preemption actually parked bg.
+    for _ in range(6):
+        out += eng.step()
+        if eng.stats["parked"]:
+            break
+    assert eng.stats["parked"] == 1
+    # Expire the parked request's deadline while it waits.
+    eng._parked[0]["state"]["req"].deadline_s = 1e-6
+    done = {c.id: c for c in out + eng.run()}
+    assert done[rb].finish_reason == "deadline"
+    n_partial = len(done[rb].tokens) - done[rb].prompt_len
+    assert n_partial >= 1, "partial tokens must ride the typed completion"
+    assert len(done[rb].token_latencies_s) == n_partial
+    assert done[rb].tenant == "bg"
+    assert done[rf].ok
+    assert eng.stats["resumed"] == 0, "expired parked must not resume"
+    # Blocks released: everything not free is held ONLY by the prefix
+    # cache (evictable capacity), and no reservation lingers.
+    assert eng.decode._reserved_future == 0
+    assert not eng.decode._parked_held
+    cache_held = {
+        b for ids in eng.decode._prefix_cache.values() for b in ids
+    }
+    assert len(eng.decode._free) + len(cache_held) == eng.pool_blocks - 1
+    eng.close()
+
+
+@pytest.mark.fast
+def test_disagg_deferred_head_keeps_its_turn(gpt):
+    """FIFO within a class, like colocated admission: a head request
+    whose launch defers (pool headroom, slot capacity) keeps its
+    round-robin turn — the cursor commits only when a request actually
+    launches, so a stream of small same-class peers cannot starve a
+    large deferred head by jumping it on every tick."""
+    model, params, _ = gpt
+    eng = DisaggServingEngine(
+        model, params, num_slots=2, temperature=0.0, kv_block_size=8,
+        tenants=[TenantSpec("a", "standard"), TenantSpec("b", "standard")],
+    )
+    ra = eng.submit(np.arange(9, dtype=np.int32), 4, tenant="a")
+    eng.submit(np.arange(3, dtype=np.int32), 3, tenant="b")
+    # Two uncommitted picks return the SAME head — a deferral between
+    # them must not rotate the cursor past tenant a.
+    q1, r1, s1, rr1 = eng._next_request()
+    q2, r2, s2, rr2 = eng._next_request()
+    assert r1.id == ra and r2.id == ra and s1.name == "a"
+    # Committing the pick rotates to tenant b, the weighted-RR behavior.
+    eng._commit_rr(rr1)
+    _, r3, s3, _ = eng._next_request()
+    assert s3.name == "b"
+    done = {c.id: c for c in eng.run()}
+    assert all(c.ok for c in done.values())
+    eng.close()
+
+
+def test_disagg_separate_partition_prefix_transfer_is_windowed(gpt):
+    """Cross-partition handoffs move the occupied WINDOW, never the
+    bucket: a no-hit handoff transfers exactly its prompt's block
+    window back (not the power-of-two slot bucket), and a prefix-hit
+    admission transfers the seed's occupied prefix out plus only the
+    private blocks back — all pinned EXACTLY against the analytic
+    window bytes. (Cross-partition prefix reuse saves prefill COMPUTE;
+    link bytes are symmetric — seed-out ≈ prefix-back — which these
+    pins document.)"""
+    model, params, _ = gpt
+    cfg = model.config
+    penv = build_mesh(MeshConfig(data=1), devices=[jax.devices()[1]])
+    bs = 8
+    eng = DisaggServingEngine(
+        model, params, num_slots=2, temperature=0.0, kv_block_size=bs,
+        prefill_env=penv,
+    )
+
+    def window_bytes(tok):  # K/V fp32 payload + index rows, per transfer
+        per = cfg.num_layers * 2 * tok * cfg.hidden_dim * 4
+        return per + cfg.num_layers * 4 + 4  # cache_index [L,1] + pos_index
+
+    pre = np.arange(2 * bs, dtype=np.int32) % 64
+    p1 = np.concatenate([pre, np.asarray([7, 9, 1], np.int32)])  # 19 tok
+    p2 = np.concatenate([pre, np.asarray([11, 3], np.int32)])  # 18 tok
+    r1 = eng.submit(p1, 4)
+    done1 = {c.id: c for c in eng.run()}
+    m1 = eng.stats["handoff_transfer_bytes"]
+    # No hit: backward only — the 3-block window (24 tok), NOT the
+    # 32-token bucket the slot cache is shaped to.
+    assert m1 == window_bytes(3 * bs), (m1, window_bytes(3 * bs))
+    r2 = eng.submit(p2, 4)
+    done2 = {c.id: c for c in eng.run()}
+    m2 = eng.stats["handoff_transfer_bytes"] - m1
+    assert done2[r2].prefix_cache_hit
+    # Hit: the 2-block seed crosses out, ONE private block crosses back.
+    assert m2 == window_bytes(2 * bs) + window_bytes(bs), m2
+    for rid, p, d in ((r1, p1, done1), (r2, p2, done2)):
+        ref = generate(
+            model, params, jnp.asarray(p)[None], max_new_tokens=4,
+            temperature=0.0,
+        )
+        np.testing.assert_array_equal(d[rid].tokens, np.asarray(ref)[0])
+    eng.close()
+
+
+def test_disagg_sequential_latency_after_preemption_no_livelock(gpt):
+    """Regression (review round 5): a queued latency request and a
+    parked best-effort victim must not wait on each other forever. With
+    one slot, fg1 preempts bg; after fg1 completes, fg2 must take the
+    free slot (the parked bg does not reserve it — it outranks only
+    non-latency placements), and bg resumes once the latency stream
+    drains — every request completes, token-identically."""
+    model, params, _ = gpt
+    eng = DisaggServingEngine(
+        model, params, num_slots=1, temperature=0.0, kv_block_size=8,
+        tenants=[TenantSpec("fg", "latency"),
+                 TenantSpec("bg", "best_effort")],
+    )
+    pb = np.arange(4, dtype=np.int32)
+    pf1 = (pb + 7) % 64
+    pf2 = (pb + 23) % 64
+    rb = eng.submit(pb, 16, tenant="bg")
+    out = []
+    for _ in range(4):
+        out += eng.step()
+    rf1 = eng.submit(pf1, 4, tenant="fg")
+    # Drive until fg1 finished; THEN submit fg2 — the livelock shape:
+    # free slot + parked bg + queued latency.
+    for _ in range(30):
+        out += eng.step()
+        if any(c.id == rf1 for c in out):
+            break
+    assert any(c.id == rf1 for c in out), "fg1 never completed"
+    rf2 = eng.submit(pf2, 4, tenant="fg")
+    done = {c.id: c for c in out + eng.run(max_steps=300)}
+    assert sorted(done) == [rb, rf1, rf2], (
+        f"livelock: resolved only {sorted(done)}"
+    )
+    for rid, (p, n) in ((rb, (pb, 16)), (rf1, (pf1, 4)), (rf2, (pf2, 4))):
+        ref = generate(
+            model, params, jnp.asarray(p)[None], max_new_tokens=n,
+            temperature=0.0,
+        )
+        np.testing.assert_array_equal(done[rid].tokens, np.asarray(ref)[0])
+    assert eng.stats["parked"] >= 1 and eng.stats["resumed"] >= 1
+    eng.close()

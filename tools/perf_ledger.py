@@ -63,6 +63,7 @@ DEFAULT_RECIPES = ("mnist_mlp", "gpt2_medium_tp_overlap")
 SERVING_PROGRAM = "serving:decode_step"
 PAGED_SERVING_PROGRAM = "serving:decode_step_paged"
 VERIFY_SERVING_PROGRAM = "serving:verify_step_paged"
+HANDOFF_PROGRAM = "serving:handoff"
 
 #: Analytic row fields --check compares EXACTLY. Everything else in a row
 #: (intensity, roofline, measured) is either derived from these or
@@ -152,7 +153,9 @@ def analytic_recipe_row(name: str, workdir: str) -> dict:
     }
 
 
-def analytic_serving_row(paged: bool = False, verify: bool = False) -> dict:
+def analytic_serving_row(
+    paged: bool = False, verify: bool = False, handoff: bool = False,
+) -> dict:
     """Same, for the serving decode step (the graft-lint program, shared
     via analysis.runner.build_decode_step_program). ``paged=True`` builds
     the ISSUE-10 block-table decode step instead
@@ -172,11 +175,55 @@ def analytic_serving_row(paged: bool = False, verify: bool = False) -> dict:
     )
     from frl_distributed_ml_scaffold_tpu.analysis.runner import (
         build_decode_step_program,
+        build_handoff_program,
         build_paged_decode_step_program,
         build_verify_step_program,
     )
     from frl_distributed_ml_scaffold_tpu.utils.flops import jaxpr_flops
 
+    if handoff:
+        # The handoff SPLICE row (ISSUE 12): the analytic cost of moving
+        # a finished prefill decode-side. The headline is what the row
+        # PINS: ownership moves as one block-table row
+        # (``splice_table_bytes`` — int32 per table slot), the program
+        # writes only the private blocks that change owner
+        # (``splice_blocks_written`` x block bytes), and NOTHING moves
+        # collectively (``collective_bytes_per_step`` == 0, the
+        # reshard-free splice) — table bytes, not cache bytes.
+        from frl_distributed_ml_scaffold_tpu.models.generation import (
+            SLOT_LEAF_OF,
+            pool_block_bytes,
+        )
+
+        model, pool_cache, slot_cache, blk_ids, jaxpr = (
+            build_handoff_program()
+        )
+        census = collective_census(jaxpr)
+        flops = jaxpr_flops(jaxpr)
+        comm = sum(r.total_bytes for r in census)
+        chips = jax.device_count()
+        block_size = next(
+            l.shape[2]
+            for p, l in jax.tree_util.tree_flatten_with_path(pool_cache)[0]
+            if getattr(p[-1], "key", None) in SLOT_LEAF_OF
+        )
+        table_blocks = model.config.seq_len // block_size
+        return {
+            "flops_per_step": flops,
+            "collective_bytes_per_step": comm,
+            "collectives": {
+                prim: agg
+                for prim, agg in sorted(census_summary(census).items())
+            },
+            "params_bytes": 0,  # the splice never touches params
+            "chips": chips,
+            "cache_bytes": _tree_bytes(pool_cache),
+            "splice_table_bytes": table_blocks * 4,
+            "splice_blocks_written": int(blk_ids.shape[0]),
+            "splice_block_bytes": pool_block_bytes(pool_cache),
+            "intensity_flops_per_byte": round(flops / max(comm, 1), 3),
+            "roofline": _roofline(flops, comm, chips),
+        }
     build = (
         build_verify_step_program if verify
         else build_paged_decode_step_program if paged
@@ -347,6 +394,12 @@ def build_ledger(
         # measured accepted-per-verify / invocations-per-token columns.
         print(f"perf_ledger: tracing {VERIFY_SERVING_PROGRAM}", flush=True)
         rows[VERIFY_SERVING_PROGRAM] = analytic_serving_row(verify=True)
+        # The prefill→decode handoff splice (ISSUE 12): analytic-only —
+        # the row pins the splice at table bytes, not cache bytes
+        # (ownership = one int32 table row; zero collective bytes), the
+        # analytic face of serve_bench's *_disagg tail-isolation columns.
+        print(f"perf_ledger: tracing {HANDOFF_PROGRAM}", flush=True)
+        rows[HANDOFF_PROGRAM] = analytic_serving_row(handoff=True)
     from frl_distributed_ml_scaffold_tpu.utils.flops import (
         peak_flops_per_chip,
     )
@@ -372,12 +425,14 @@ def check_ledger(
     problems: list[str] = []
     for program, base in sorted(baseline.get("rows", {}).items()):
         if program in (
-            SERVING_PROGRAM, PAGED_SERVING_PROGRAM, VERIFY_SERVING_PROGRAM
+            SERVING_PROGRAM, PAGED_SERVING_PROGRAM, VERIFY_SERVING_PROGRAM,
+            HANDOFF_PROGRAM,
         ):
             try:
                 cur = analytic_serving_row(
                     paged=program == PAGED_SERVING_PROGRAM,
                     verify=program == VERIFY_SERVING_PROGRAM,
+                    handoff=program == HANDOFF_PROGRAM,
                 )
             except Exception as e:
                 problems.append(
@@ -405,13 +460,13 @@ def check_ledger(
                     f"{json.dumps(base.get(key))} vs current "
                     f"{json.dumps(cur.get(key))}"
                 )
-        if "cache_bytes" in base and base["cache_bytes"] != cur.get(
-            "cache_bytes"
-        ):
-            problems.append(
-                f"{program}: cache_bytes drifted — baseline "
-                f"{base['cache_bytes']} vs current {cur.get('cache_bytes')}"
-            )
+        for extra in ("cache_bytes", "splice_table_bytes",
+                      "splice_blocks_written", "splice_block_bytes"):
+            if extra in base and base[extra] != cur.get(extra):
+                problems.append(
+                    f"{program}: {extra} drifted — baseline "
+                    f"{base[extra]} vs current {cur.get(extra)}"
+                )
         if measure_steps > 0 and program.startswith("recipe:"):
             base_t = (base.get("measured") or {}).get("step_time_p50_s", 0.0)
             if base_t > 0:

@@ -44,10 +44,25 @@ vs a ``speculate=off`` engine on the same workload. Output is
 token-identical either way (greedy acceptance is exact), so the columns
 are pure perf.
 
+The ``_disagg`` suffix (ISSUE 12, paged arms only) serves the workload
+through the disaggregated prefill/decode scheduler
+(serving/scheduler.py) and additionally runs a MIXED BURST workload —
+a decode-heavy latency tenant under a prefill-heavy best-effort burst —
+through BOTH engines, reporting decode TPOT p99 under the burst for
+each (``serving.disagg``): colocated admission prefills into every free
+slot inline before each decode tick, so the burst lands in the decode
+tenant's inter-token gaps; the scheduler's decoupled admission defers
+the burst instead (tail isolation, pinned >= 2x in test_serving.py).
+The handoff is a block-table splice — ``handoff_transfer_bytes`` is 0
+when the partitions share the pool (re-own). Under ``--chaos`` the
+disagg sub-dict adds a worker-fault pass (``serve.prefill_worker`` /
+``serve.handoff`` injections re-queue; every request still resolves).
+
     python tools/serve_bench.py --preset tiny --requests 12 --slots 4
     python tools/serve_bench.py --preset tiny --arms flash_sharded,flash_sharded_int8
     python tools/serve_bench.py --preset tiny --arms flash_replicated,flash_replicated_paged
     python tools/serve_bench.py --preset tiny --arms flash_replicated_paged_spec_ngram
+    python tools/serve_bench.py --preset tiny --arms flash_replicated_paged_disagg
 """
 
 from __future__ import annotations
@@ -74,9 +89,11 @@ def _parse_args(argv=None):
                    "dense_sharded,flash_sharded,flash_replicated_int8,"
                    "flash_sharded_int8,flash_replicated_paged,"
                    "flash_replicated_paged_int8,"
-                   "flash_replicated_paged_spec_ngram",
+                   "flash_replicated_paged_spec_ngram,"
+                   "flash_replicated_paged_disagg",
                    help="comma-separated: {dense,flash}_{replicated,"
-                   "sharded}[_paged][_int8|_fp8][_spec[_ngram|_draft]]")
+                   "sharded}[_paged][_int8|_fp8][_spec[_ngram|_draft]]"
+                   "[_disagg]")
     p.add_argument("--model-axis", type=int, default=2,
                    help="model-axis size for the sharded arms")
     p.add_argument("--block-size", type=int, default=16,
@@ -516,6 +533,182 @@ def _spec_pass(model, run_params, args, kv_kwargs, draft_kwargs) -> dict:
     }
 
 
+def _disagg_workload(cfg, slots: int, max_new: int, seed: int):
+    """The mixed prefill-heavy/decode-heavy workload the disaggregation
+    A/B serves: a small DECODE-HEAVY foreground (short prompts, long
+    budgets — the latency tenant whose TPOT tail is measured) plus a
+    PREFILL-HEAVY burst (near-half-context prompts, budget 1 — the
+    embedding/classification shape that is pure prefill, the workload
+    disaggregation exists for)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed + 5)
+    vocab = cfg.vocab_size
+    dec_budget = max(16, min(2 * max_new, cfg.seq_len // 8))
+    dec = [
+        (rng.integers(0, vocab, size=int(rng.integers(4, 9)))
+         .astype(np.int32), dec_budget)
+        for _ in range(2)
+    ]
+    long_l = cfg.seq_len // 2
+    pre = [
+        (rng.integers(0, vocab, size=long_l - int(rng.integers(0, 8)))
+         .astype(np.int32), 1)
+        for _ in range(3 * slots)
+    ]
+    return dec, pre
+
+
+def _decode_gaps_ms(done, dec_ids):
+    """Inter-token gaps (ms) of the decode-heavy requests, from the
+    Completion token-arrival times — the TPOT a decoding tenant actually
+    experiences, inline prefill stalls included."""
+    import numpy as np
+
+    gaps = []
+    for c in done:
+        if c.id in dec_ids and len(c.token_times_s) > 1:
+            gaps.extend(np.diff(np.asarray(c.token_times_s)) * 1e3)
+    return np.asarray(gaps, np.float64)
+
+
+def _disagg_pass(model, run_params, args, kv_kwargs) -> dict:
+    """The disaggregation headline, measured (ISSUE 12 acceptance):
+    serve the mixed burst workload through the colocated paged engine
+    AND through the disaggregated scheduler, and report decode TPOT
+    under the prefill burst for both. Colocated admission runs a full
+    prefill into EVERY free slot inline before each decode tick, so the
+    burst's wall time lands inside the foreground's inter-token gaps;
+    the scheduler's decoupled admission (``prefill_max_per_tick``)
+    defers the burst instead — tail isolation without touching decode
+    throughput. Both passes follow the warm-up discipline; outputs are
+    token-identical (pinned in tests/test_serving.py), so the columns
+    are pure scheduling. With ``--chaos``, a third disaggregated pass
+    injects the ``serve.prefill_worker``/``serve.handoff`` sites and
+    proves the re-queue path: every request still resolves."""
+    import numpy as np
+
+    from frl_distributed_ml_scaffold_tpu import faults
+    from frl_distributed_ml_scaffold_tpu.faults import FaultPlan
+    from frl_distributed_ml_scaffold_tpu.serving import (
+        DisaggServingEngine,
+        ServingEngine,
+        TenantSpec,
+    )
+
+    slots = max(args.slots, 6)
+    dec, pre = _disagg_workload(
+        model.config, slots, args.max_new, args.seed
+    )
+    kv = {
+        k: v for k, v in kv_kwargs.items() if not k.startswith("speculate")
+    }
+
+    def serve(disagg: bool, plan=None):
+        if disagg:
+            eng = DisaggServingEngine(
+                model, run_params, num_slots=slots, temperature=0.0,
+                tenants=[
+                    TenantSpec("fg", "latency"),
+                    TenantSpec("bg", "best_effort"),
+                ],
+                **kv,
+            )
+        else:
+            eng = ServingEngine(
+                model, run_params, num_slots=slots, temperature=0.0, **kv
+            )
+
+        def submit_all():
+            ids = []
+            for p, n in dec:
+                ids.append(
+                    eng.submit(p, n, tenant="fg") if disagg
+                    else eng.submit(p, n)
+                )
+            for p, n in pre:
+                (eng.submit(p, n, tenant="bg") if disagg
+                 else eng.submit(p, n))
+            return set(ids)
+
+        submit_all()  # warm pass: compiles every shape
+        eng.run()
+        eng.reset_cache()
+        if plan is not None:
+            with faults.active(plan):
+                dec_ids = submit_all()
+                done = eng.run()
+        else:
+            dec_ids = submit_all()
+            done = eng.run()
+        eng.close()
+        assert len(done) == len(dec) + len(pre), (len(done),)
+        return eng, done, dec_ids
+
+    eng_c, done_c, ids_c = serve(disagg=False)
+    eng_d, done_d, ids_d = serve(disagg=True)
+    gaps_c = _decode_gaps_ms(done_c, ids_c)
+    gaps_d = _decode_gaps_ms(done_d, ids_d)
+    colo_p99 = float(np.percentile(gaps_c, 99))
+    dis_p99 = float(np.percentile(gaps_d, 99))
+    handoff_h = eng_d.telemetry.histogram("serve_handoff_seconds")
+    out = {
+        "slots": slots,
+        "decode_requests": len(dec),
+        "burst_requests": len(pre),
+        "decode_budget": int(dec[0][1]),
+        "burst_prompt_tokens": int(sum(len(p) for p, _ in pre)),
+        # The acceptance number: decode TPOT p99 UNDER THE PREFILL
+        # BURST, colocated vs disaggregated (gap-based — the tail the
+        # decoding tenant actually sees).
+        "colocated_decode_tpot_p50_ms": round(
+            float(np.percentile(gaps_c, 50)), 3
+        ),
+        "colocated_decode_tpot_p99_ms": round(colo_p99, 3),
+        "disagg_decode_tpot_p50_ms": round(
+            float(np.percentile(gaps_d, 50)), 3
+        ),
+        "disagg_decode_tpot_p99_ms": round(dis_p99, 3),
+        "tail_isolation_x": round(colo_p99 / max(dis_p99, 1e-9), 4),
+        "handoffs": int(eng_d.stats["handoffs"]),
+        "handoff_p50_ms": round(handoff_h.quantile(0.50) * 1e3, 3),
+        "prefill_deferred": int(eng_d.stats["prefill_deferred"]),
+        "preemptions": int(eng_d.stats["preemptions"]),
+        # 0 when the partitions share the pool: the splice is a re-own.
+        "handoff_transfer_bytes": int(
+            eng_d.stats["handoff_transfer_bytes"]
+        ),
+    }
+    if args.chaos:
+        # Worker-boundary chaos (the serve.prefill_worker/serve.handoff
+        # sites): one prefill-worker death and one handoff failure mid
+        # burst — both re-queue and every request still resolves (the
+        # assert inside serve()), the never-hangs contract across the
+        # worker boundary.
+        plan = FaultPlan(
+            [
+                dict(site="serve.prefill_worker", at=2, times=1),
+                dict(site="serve.handoff", at=3, times=1),
+            ],
+            seed=args.seed,
+        )
+        eng_f, done_f, _ = serve(disagg=True, plan=plan)
+        out["chaos"] = {
+            "injected": dict(plan.injected),
+            "prefill_worker_failures": int(
+                eng_f.stats["prefill_worker_failures"]
+            ),
+            "handoff_failures": int(eng_f.stats["handoff_failures"]),
+            "requeued": int(
+                eng_f.stats["prefill_worker_requeued"]
+                + eng_f.stats["handoff_requeued"]
+            ),
+            "completed": len(done_f),
+            "completed_ok": sum(1 for c in done_f if c.ok),
+        }
+    return out
+
+
 def run_arm(model, params, arm: str, args, flops_per_token: int) -> dict:
     """One (decode impl, sharding) arm through the engine; returns the
     BENCH_TABLE-schema row."""
@@ -542,20 +735,23 @@ def run_arm(model, params, arm: str, args, flops_per_token: int) -> dict:
     quants = [s for s in suffixes if s in ("int8", "fp8")]
     spec = "spec" in suffixes
     spec_mode = "draft" if "draft" in suffixes else "ngram"
+    disagg = "disagg" in suffixes
     if (
         len(parts) < 2
         or parts[0] not in ("dense", "flash")
         or parts[1] not in ("replicated", "sharded")
         or len(quants) > 1
-        or any(s not in ("paged", "int8", "fp8", "spec", "ngram", "draft")
+        or any(s not in ("paged", "int8", "fp8", "spec", "ngram", "draft",
+                         "disagg")
                for s in suffixes)
         or (("ngram" in suffixes or "draft" in suffixes) and not spec)
         or (spec and not paged)
+        or (disagg and not paged)
     ):
         raise ValueError(
             f"unknown arm {arm!r}: want {{dense,flash}}_{{replicated,"
-            "sharded}[_paged][_int8|_fp8][_spec[_ngram|_draft]] "
-            "(spec requires paged)"
+            "sharded}[_paged][_int8|_fp8][_spec[_ngram|_draft]][_disagg] "
+            "(spec and disagg require paged)"
         )
     impl, sharding = parts[:2]
     quant = {"int8": "int8", "fp8": "fp8_e4m3"}[quants[0]] if quants else "none"
@@ -593,10 +789,23 @@ def run_arm(model, params, arm: str, args, flops_per_token: int) -> dict:
         if spec_mode == "draft":
             draft_kwargs = _build_draft(model.config)
     with mesh_context(env):
-        eng = ServingEngine(
-            model, run_params, num_slots=args.slots, temperature=0.0,
-            **kv_kwargs, **draft_kwargs,
-        )
+        if disagg:
+            # The disaggregated facade serves the main pass (same public
+            # API; single default tenant) — the burst A/B sub-dict below
+            # additionally compares it against the colocated engine.
+            from frl_distributed_ml_scaffold_tpu.serving import (
+                DisaggServingEngine,
+            )
+
+            eng = DisaggServingEngine(
+                model, run_params, num_slots=args.slots, temperature=0.0,
+                **kv_kwargs, **draft_kwargs,
+            )
+        else:
+            eng = ServingEngine(
+                model, run_params, num_slots=args.slots, temperature=0.0,
+                **kv_kwargs, **draft_kwargs,
+            )
         # Warm-up pass: the SAME workload once through the engine, so
         # every compiled shape the measured pass will hit (each prompt
         # bucket's prefill, each cache bucket's decode step, the grafts
@@ -697,6 +906,10 @@ def run_arm(model, params, arm: str, args, flops_per_token: int) -> dict:
             specd = _spec_pass(
                 model, run_params, args, kv_kwargs, draft_kwargs
             )
+    disagg_cols = None
+    if disagg:
+        with mesh_context(env):
+            disagg_cols = _disagg_pass(model, run_params, args, kv_kwargs)
     # SLO columns from the engine's telemetry histograms (ISSUE 7): the
     # warm-up pass's observations were dropped by reset_cache, so these
     # aggregate exactly the measured pass. TTFT is the prefill+graft
@@ -760,6 +973,7 @@ def run_arm(model, params, arm: str, args, flops_per_token: int) -> dict:
             # the per-request Completion.spec_accept_rate mean next to
             # the slot-level decode-invocations-per-emitted-token.
             "speculate": spec_mode if spec else "off",
+            "disaggregated": disagg,
             "spec_accept_rate": round(
                 sum(c.spec_accept_rate for c in done) / len(done), 4
             ),
@@ -771,6 +985,7 @@ def run_arm(model, params, arm: str, args, flops_per_token: int) -> dict:
             **({"paged": paged_cols} if paged_cols is not None else {}),
             **({"prefix": prefix} if prefix is not None else {}),
             **({"spec_repetitive": specd} if specd is not None else {}),
+            **({"disagg": disagg_cols} if disagg_cols is not None else {}),
             **({"chaos": chaos} if chaos is not None else {}),
         },
         "note": (
@@ -840,6 +1055,18 @@ def main(argv=None) -> int:
                 f"{sp['mean_accepted_per_verify']:.2f} tok/verify  "
                 f"{sp['decode_invocations_per_token']:.3f} inv/tok "
                 f"({sp['invocations_reduction_x']:.2f}x fewer vs off)",
+                file=sys.stderr,
+            )
+        if "disagg" in s:
+            d = s["disagg"]
+            print(
+                f"# {'disagg':>23s}: decode TPOT p99 under burst "
+                f"{d['disagg_decode_tpot_p99_ms']:.2f} ms vs colocated "
+                f"{d['colocated_decode_tpot_p99_ms']:.2f} ms "
+                f"({d['tail_isolation_x']:.2f}x isolation)  "
+                f"{d['handoffs']} handoffs  "
+                f"{d['handoff_transfer_bytes']} B moved  "
+                f"{d['prefill_deferred']} deferred",
                 file=sys.stderr,
             )
         if "chaos" in s:
