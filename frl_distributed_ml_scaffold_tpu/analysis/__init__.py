@@ -1,7 +1,7 @@
 """graft-lint: jaxpr/HLO static analysis for performance invariants.
 
 The subsystem behind ``tools/graft_lint.py`` and the ``analysis.pins``
-pytest API (docs/static_analysis.md).  Five passes over three program
+pytest API (docs/static_analysis.md).  Six passes over three program
 artifacts:
 
 ====================  ==========================  =======================
@@ -12,6 +12,7 @@ reshard detector      jaxpr + compiled HLO        analysis.reshard
 materialization       closed jaxpr                analysis.materialization
 donation audit        lowered + compiled text     analysis.donation
 traced-code hygiene   Python AST                  analysis.hygiene
+declared schedule     jaxpr + OverlapSchedule     analysis.schedule
 ====================  ==========================  =======================
 
 ``analysis.pins`` wraps the passes as test assertions; ``analysis.runner``

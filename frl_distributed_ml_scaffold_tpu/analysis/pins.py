@@ -52,6 +52,7 @@ from frl_distributed_ml_scaffold_tpu.analysis.reshard import (
 )
 
 __all__ = [
+    "assert_schedule",
     "collective_census",
     "collective_bytes",
     "eqn_output_shapes",
@@ -68,6 +69,69 @@ __all__ = [
     "assert_aliased",
     "assert_reshard_free",
 ]
+
+
+# ------------------------------------------------------- schedule pins
+
+
+def assert_schedule(
+    jaxpr: Any,
+    schedule: Any,
+    *,
+    axis_sizes: dict[str, int],
+    param_slices: Iterable[tuple[int, ...]] | None = None,
+    baseline_census: Any = None,
+    min_wire_ratio: float = 3.5,
+    msg: str | None = None,
+) -> None:
+    """The program satisfies the invariants DERIVED from its declared
+    ``parallel.schedule.OverlapSchedule`` (ISSUE 13): ring-chunk gathers
+    really are whole ppermute chains (and, with no blockwise rule, the
+    step is all_gather-free); blockwise gathers move only per-block param
+    slices inside the layer scans with the explicit reduce_scatter
+    present; a ``lowp`` ring's ppermute payloads are the declared 1-byte
+    format with only scale-sized wide traffic (analysis/schedule.py is
+    the one derivation, shared with graft-lint's per-recipe runner).
+
+    ``param_slices`` (``parallel.partition.block_param_slice_shapes``) is
+    required when a block rule is declared on a populated axis.
+
+    ``baseline_census`` arms the declared-lowp WIRE-RATIO pin: pass the
+    collective census of the SAME schedule without ``lowp`` (the wide
+    ring) and the declared ring axis's ppermute bytes must shrink by at
+    least ``min_wire_ratio`` (default 3.5x — the 4x fp32→int8 element
+    width minus scale traffic).
+    """
+    from frl_distributed_ml_scaffold_tpu.analysis.schedule import (
+        ring_ppermute_bytes,
+        schedule_findings,
+    )
+
+    bad = schedule_findings(
+        jaxpr, schedule, axis_sizes=axis_sizes, param_slices=param_slices
+    )
+    assert not bad, _fail(
+        msg,
+        f"program violates its declared schedule "
+        f"{schedule.render()!r}: "
+        + "; ".join(f.message for f in bad[:4])
+        + (f" (+{len(bad) - 4} more)" if len(bad) > 4 else ""),
+    )
+    ring = schedule.ring_gather()
+    if baseline_census is not None and ring is not None and ring.lowp:
+        base = ring_ppermute_bytes(_census_of(baseline_census), ring.axis)
+        cur = ring_ppermute_bytes(collective_census(jaxpr), ring.axis)
+        assert cur > 0, _fail(
+            msg, f"lowp schedule moves no {ring.axis}-axis ppermute bytes"
+        )
+        ratio = base / cur
+        assert ratio >= min_wire_ratio, _fail(
+            msg,
+            f"declared lowp={ring.lowp} ring moves {cur} ppermute "
+            f"bytes/step on axis {ring.axis!r} vs {base} for the wide "
+            f"baseline — only {ratio:.2f}x lower, pinned >= "
+            f"{min_wire_ratio}x",
+        )
 
 
 # ------------------------------------------------------------ jaxpr pins

@@ -12,11 +12,11 @@ Per-recipe invariants enforced as ``severity:error``:
 
 - donation: every params/opt-state leaf of the train state is donated in
   the lowered step (the jit's ``donate_argnums=(0,)`` actually took).
-- tp_overlap recipes: zero ``all_gather`` eqns on a pure-TP mesh (PR 3's
-  pin, now recipe-level).
-- fsdp_overlap recipes: every ``all_gather`` output is a per-block param
-  slice and the gathers sit inside scan bodies; an explicit
-  ``reduce_scatter`` exists (PR 2's pins).
+- overlap recipes: the declared-schedule checker (analysis/schedule.py,
+  ISSUE 13) — expectations derived from the recipe's ``OverlapSchedule``
+  declaration itself, absorbing PR 3's zero-all_gather and PR 2's
+  blockwise/reduce-scatter pins plus PR 6's lowp payload/bytes pins.
+  Also emitted per recipe as the ``schedule:<name>`` program family.
 - optional materialization budget (``--budget-mb``).
 
 The serving decode lint builds the tiny-GPT decode step at a 16-token
@@ -40,20 +40,9 @@ from frl_distributed_ml_scaffold_tpu.analysis.donation import (
     donation_findings,
 )
 from frl_distributed_ml_scaffold_tpu.analysis.findings import Report
-from frl_distributed_ml_scaffold_tpu.analysis.jaxpr_utils import (
-    top_level_scans,
-)
 from frl_distributed_ml_scaffold_tpu.analysis.materialization import (
     materialization_findings,
 )
-from frl_distributed_ml_scaffold_tpu.analysis.pins import (
-    primitive_shapes,
-    scan_collective_counts,
-)
-from frl_distributed_ml_scaffold_tpu.analysis.reshard import (
-    monolithic_gather_findings,
-)
-from frl_distributed_ml_scaffold_tpu.ops.quantization import lowp_dtype
 
 _COMMON = [
     "precision.policy=fp32",
@@ -97,10 +86,11 @@ _PP_TINY = [
 ]
 
 #: Wide-dtype ppermute payloads at or under this many bytes/call are
-#: quantization SCALES (a per-chunk scalar, f32 <= 4 bytes; kept generous
-#: for per-row scale vectors), not chunk traffic — the carve-out the
-#: wide-ppermute error and the pinned bytes budgets share.
-_SCALE_BYTES_PER_CALL = 256
+#: quantization SCALES, not chunk traffic — the carve-out is owned by
+#: the declarative schedule checker; aliased here for back-compat.
+from frl_distributed_ml_scaffold_tpu.analysis.schedule import (
+    SCALE_BYTES_PER_CALL as _SCALE_BYTES_PER_CALL,
+)
 
 # CPU-sim (8 virtual devices) shrink overrides per registered recipe —
 # the test_recipes.py discipline, centralized. A NEW recipe must either
@@ -125,6 +115,10 @@ RECIPE_OVERRIDES: dict[str, list[str]] = {
     + ["mesh.data=1", "mesh.model=8"],
     "gpt2_medium_tp_overlap_int8": _GPT_TINY
     + ["mesh.data=1", "mesh.model=8"],
+    "gpt2_medium_fsdp_tp_overlap": _GPT_TINY
+    + ["mesh.fsdp=4", "mesh.model=2", "parallel.fsdp_min_size=16"],
+    "gpt2_medium_fsdp_tp_overlap_int8": _GPT_TINY
+    + ["mesh.fsdp=4", "mesh.model=2", "parallel.fsdp_min_size=16"],
     "gpt2_tp": _GPT_TINY + ["mesh.data=4", "mesh.model=2"],
     "gpt2_ring": [
         "model.vocab_size=128", "model.num_layers=2", "model.num_heads=4",
@@ -187,36 +181,28 @@ def _abstract_batch(trainer) -> Any:
     }
 
 
-def _param_slice_shapes(state_shapes, model_axis: int) -> set[tuple]:
-    """Legal all_gather output shapes for an overlap schedule: per-block
-    slices of the stacked block params, with Megatron-split dims also
-    allowed at 1/model_axis (the per-shard view inside shard_map)."""
-    import jax
+def _recipe_schedule(cfg):
+    """The recipe's declared overlap schedule (None when it runs the
+    plain GSPMD schedules)."""
+    from frl_distributed_ml_scaffold_tpu.parallel.schedule import (
+        schedule_from_config,
+    )
 
-    slices: set[tuple] = set()
-    blocks = getattr(state_shapes.params, "get", lambda *_: None)("blocks")
-    leaves = jax.tree.leaves(blocks) if blocks is not None else []
-    if not leaves:  # non-scanned families: any full param leaf is a block
-        leaves = jax.tree.leaves(state_shapes.params)
-        for l in leaves:
-            slices.add(tuple(l.shape))
-    for l in leaves:
-        s = tuple(l.shape[1:]) if blocks is not None else tuple(l.shape)
-        slices.add(s)
-        if model_axis > 1:
-            for i, d in enumerate(s):
-                if d % model_axis == 0:
-                    slices.add(s[:i] + (d // model_axis,) + s[i + 1:])
-    return slices
+    return schedule_from_config(cfg)
 
 
-def lint_train_step(
+def _lint_recipe_reports(
     name: str,
     *,
     workdir: str = "/tmp/graft_lint",
     budget_bytes: int | None = None,
-) -> Report:
-    """Lint one registered recipe's train step; returns its Report."""
+) -> list[Report]:
+    """One trainer build + trace for a recipe, emitted as up to two
+    reports: the per-recipe report (every pass) and — when the recipe
+    declares an overlap schedule — the ``schedule:<name>`` program
+    family report (the declaration-first view of the same schedule
+    findings, with the declaration in ``meta`` so
+    ``--save-census``/``--against`` diffs key per schedule)."""
     import jax
 
     report = Report(program=f"recipe:{name}")
@@ -240,86 +226,68 @@ def lint_train_step(
             primitive=prim, **agg,
         )
 
-    # -- pass 2: exposed-collective invariants on overlap recipes -------
-    if cfg.parallel.tp_overlap and cfg.mesh.data == 1 and not (
-        cfg.parallel.param_sharding == "fsdp"
-    ):
-        # Pure-TP collective-matmul schedule: the activation gathers ARE
-        # the ppermute rings; any explicit all_gather is a regression.
-        gathers = primitive_shapes(jaxpr, "all_gather")
-        for shapes in gathers:
-            report.add(
-                "reshard", "error", "exposed-all-gather",
-                f"tp_overlap step carries an explicit all_gather of "
-                f"{[list(s) for s in shapes]} — activations must ride "
-                "the ppermute rings",
-                shapes=[list(s) for s in shapes],
-            )
-        if not primitive_shapes(jaxpr, "ppermute"):
-            report.add(
-                "reshard", "error", "missing-rings",
-                "tp_overlap step carries no ppermute rings",
-            )
-    lp = getattr(cfg.parallel, "low_precision", "none")
-    if cfg.parallel.tp_overlap and lp != "none":
-        # The low-precision bytes pin (ISSUE 6): under a quantized recipe
-        # every ppermute payload must be 1-byte; the only wide-dtype
-        # ppermute traffic allowed is the scalar scales riding next to
-        # the chunks. A ring that silently falls back to bf16/fp32
-        # payloads moves chunk-sized wide transfers — error per eqn.
-        want = str(np.dtype(lowp_dtype(lp)))
-        for (prim, dtype), agg in sorted(census_by_dtype(census).items()):
-            if prim != "ppermute":
-                continue
-            report.add(
-                "collective_census", "info", "census-by-dtype",
-                f"ppermute[{dtype}]: {agg['eqns']} eqn(s), "
-                f"{agg['calls']} call(s)/step, {agg['total_bytes']} bytes",
-                primitive=prim, dtype=dtype, **agg,
-            )
-        wide = [
-            r for r in census
-            if r.primitive == "ppermute" and r.dtype != want
-            and r.bytes_per_call > _SCALE_BYTES_PER_CALL
-        ]
-        for r in wide:
-            report.add(
-                "collective_census", "error", "wide-ppermute",
-                f"{name}: low_precision={lp} ring ppermutes a "
-                f"{r.dtype} payload of {r.bytes_per_call} bytes/call "
-                f"(shapes {[list(s) for s in r.shapes]}) — quantization "
-                "silently fell back to wide floats",
-                **r.to_dict(),
-            )
-        if not any(r.dtype == want for r in census
-                   if r.primitive == "ppermute"):
-            report.add(
-                "collective_census", "error", "missing-lowp-rings",
-                f"{name}: low_precision={lp} but no {want} ppermute "
-                "payload exists in the step",
-            )
-    if cfg.parallel.fsdp_overlap:
-        model_axis = trainer.env.axis_size("model")
-        slices = _param_slice_shapes(state_shapes, model_axis)
-        report.extend(
-            monolithic_gather_findings(
-                jaxpr, slices, label=f"{name}: "
-            )
+    # -- pass 2: declared-schedule invariants (ISSUE 13) ----------------
+    # The recipe's OverlapSchedule declaration IS the expectation: one
+    # derivation (analysis/schedule.py) replaces the hand-written
+    # tp_overlap zero-all_gather and fsdp_overlap blockwise /
+    # reduce-scatter pins this pass used to carry — same finding codes,
+    # now derived from what the recipe DECLARES instead of which knob
+    # it flipped.
+    sched = _recipe_schedule(cfg)
+    sched_report = None
+    if sched is not None:
+        from frl_distributed_ml_scaffold_tpu.analysis.schedule import (
+            schedule_findings,
         )
-        if not primitive_shapes(jaxpr, "reduce_scatter"):
-            report.add(
-                "reshard", "error", "missing-reduce-scatter",
-                f"{name}: fsdp_overlap step has no explicit "
-                "reduce_scatter — gradients leave blocks gathered",
+        from frl_distributed_ml_scaffold_tpu.parallel.partition import (
+            block_param_slice_shapes,
+        )
+
+        report.meta["schedule"] = sched.describe()
+        slices = None
+        if sched.block_gather() is not None:
+            slices = block_param_slice_shapes(
+                state_shapes.params, trainer.env.axis_size("model")
             )
-        if top_level_scans(jaxpr) and not any(
-            n > 0 for n in scan_collective_counts(jaxpr, "all_gather")
-        ):
-            report.add(
-                "reshard", "error", "hoisted-gathers",
-                f"{name}: no scan body carries the explicit gathers — "
-                "they were hoisted out of the layer loop",
+        axis_sizes = {
+            a: trainer.env.axis_size(a)
+            for a in ("data", "fsdp", "model", "seq", "expert", "pipe")
+        }
+        found = schedule_findings(
+            jaxpr, sched, axis_sizes=axis_sizes, param_slices=slices,
+            census=census, label=f"{name}: ",
+        )
+        report.extend(found)
+        # The schedule: family rides the SAME trace — no second trainer
+        # build for the declaration-first view.
+        sched_report = Report(program=f"schedule:{name}")
+        sched_report.meta["schedule"] = sched.describe()
+        sched_report.meta["collective_census"] = report.meta[
+            "collective_census"
+        ]
+        sched_report.extend(found)
+        if sched_report.ok:
+            sched_report.add(
+                "schedule", "info", "summary",
+                f"{name}: program matches its declared schedule "
+                f"{sched.render()!r}",
             )
+        ring = sched.ring_gather()
+        if ring is not None and ring.lowp is not None:
+            # Observability: the per-dtype ppermute breakdown next to the
+            # declared-lowp errors above.
+            for (prim, dtype), agg in sorted(
+                census_by_dtype(census).items()
+            ):
+                if prim != "ppermute":
+                    continue
+                report.add(
+                    "collective_census", "info", "census-by-dtype",
+                    f"ppermute[{dtype}]: {agg['eqns']} eqn(s), "
+                    f"{agg['calls']} call(s)/step, "
+                    f"{agg['total_bytes']} bytes",
+                    primitive=prim, dtype=dtype, **agg,
+                )
 
     # -- pass 3: materialization census / budget ------------------------
     report.extend(
@@ -352,7 +320,7 @@ def lint_train_step(
                 f"{name}: no lowered argument carries a donation marker "
                 "— donate_argnums went missing",
             )
-        return report
+        return [report] + ([sched_report] if sched_report else [])
     missing = [
         p
         for p, donated in pairs
@@ -379,6 +347,42 @@ def lint_train_step(
             "marker survives in the lowered module — lowering dropped "
             "the donation",
         )
+    return [report] + ([sched_report] if sched_report else [])
+
+
+def lint_train_step(
+    name: str,
+    *,
+    workdir: str = "/tmp/graft_lint",
+    budget_bytes: int | None = None,
+) -> Report:
+    """Lint one registered recipe's train step; returns its Report."""
+    return _lint_recipe_reports(
+        name, workdir=workdir, budget_bytes=budget_bytes
+    )[0]
+
+
+def lint_schedule_program(
+    name: str, *, workdir: str = "/tmp/graft_lint"
+) -> Report:
+    """The ``schedule:`` program family (ISSUE 13): one report per
+    overlap recipe whose PROGRAM IS its declared schedule — the recipe's
+    train step checked against the expectations derived from its
+    ``OverlapSchedule`` declaration alone (analysis/schedule.py), with
+    the declaration in ``meta`` so ``--save-census``/``--against`` diffs
+    are keyed per schedule, not per recipe. Shares one trainer build +
+    trace with the per-recipe report (``_lint_recipe_reports``); a
+    recipe with no declared schedule reports ``no-schedule``."""
+    reports = _lint_recipe_reports(name, workdir=workdir)
+    for r in reports:
+        if r.program == f"schedule:{name}":
+            return r
+    report = Report(program=f"schedule:{name}")
+    report.add(
+        "schedule", "error", "no-schedule",
+        f"{name}: recipe declares no overlap schedule — the "
+        "schedule: program family only applies to overlap recipes",
+    )
     return report
 
 
@@ -1144,9 +1148,14 @@ def lint_all(
 
     for name in names:
         try:
-            emit(lint_train_step(
+            # One build + trace per recipe: the recipe report plus, for
+            # overlap recipes, the schedule: program family report
+            # (ISSUE 13 — the declaration-first view of the same
+            # findings).
+            for r in _lint_recipe_reports(
                 name, workdir=workdir, budget_bytes=budget_bytes
-            ))
+            ):
+                emit(r)
         except Exception as e:  # surface as a finding, not a crash
             r = Report(program=f"recipe:{name}")
             r.add(

@@ -1,0 +1,215 @@
+"""Declarative schedule verification: derive the expected collective
+classes/counts/bytes from a declared ``OverlapSchedule`` and check a
+program against them (ISSUE 13).
+
+Before this module, the overlap invariants were hand-written per
+mechanism: PR 3's "zero all_gather on pure TP" and PR 2's
+"blockwise gathers + reduce-scatter" lived as bespoke code in
+``analysis.runner.lint_train_step`` and ad-hoc pins in the test files.
+Now the DECLARATION is the source of truth — the same
+``parallel/schedule.py`` object the Trainer lowers into hooks also
+derives what its program must look like:
+
+- a ``ring_chunk`` gather on axis ``a`` (size ``n``) ⇒ ``ppermute``
+  chains on ``a`` exist, every layer scan's ``a``-axis ppermute count is
+  a whole number of ``(n-1)``-hop chains (a partial chain is a broken
+  ring), and — when no blockwise rule is declared — NO explicit
+  ``all_gather`` anywhere (activations must ride the rings);
+- ``lowp`` on the ring pair ⇒ every ``a``-axis ppermute payload is the
+  declared 1-byte format; the only wide-dtype ppermute traffic allowed
+  is the scalar scales riding next to the chunks (``scale_bytes_per_call``
+  budget), and quantized payload traffic must actually exist;
+- a ``block`` gather on axis ``b`` ⇒ explicit ``all_gather``s exist,
+  every one of them moves a per-block param slice
+  (``parallel.partition.block_param_slice_shapes``), they sit inside the
+  layer scans (not hoisted), and the declared scatter's explicit
+  ``reduce_scatter`` exists.
+
+Consumed two ways: ``analysis.pins.assert_schedule`` raises on any
+violation (the pytest face), and ``analysis.runner.lint_train_step``
+reports the same findings per recipe (the CLI face) — one derivation,
+mutation-gated in tests/test_schedule.py (a GSPMD fallback and a wide
+fp32 ring under a ``lowp`` schedule must both trip).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable
+
+import numpy as np
+
+from frl_distributed_ml_scaffold_tpu.analysis.collectives import (
+    CollectiveRecord,
+    collective_census,
+)
+from frl_distributed_ml_scaffold_tpu.analysis.findings import Finding
+from frl_distributed_ml_scaffold_tpu.analysis.jaxpr_utils import (
+    primitive_shapes,
+    top_level_scans,
+)
+from frl_distributed_ml_scaffold_tpu.analysis.reshard import (
+    monolithic_gather_findings,
+)
+from frl_distributed_ml_scaffold_tpu.ops.quantization import lowp_dtype
+
+#: Wide-dtype ppermute payloads at or under this many bytes/call are
+#: quantization SCALES (a per-chunk scalar, f32 <= 4 bytes; kept generous
+#: for per-row scale vectors), not chunk traffic — the carve-out the
+#: wide-ppermute error and the pinned bytes budgets share (moved here
+#: from analysis.runner, which now reads it back).
+SCALE_BYTES_PER_CALL = 256
+
+
+def ring_ppermute_bytes(
+    census: Iterable[CollectiveRecord], axis: str
+) -> int:
+    """Total per-step ppermute wire bytes on one ring axis — the
+    measurement half of the declared-lowp wire-ratio pin."""
+    return sum(
+        r.total_bytes
+        for r in census
+        if r.primitive == "ppermute" and axis in r.axes
+    )
+
+
+def _scan_axis_ppermute_counts(jaxpr: Any, axis: str) -> list[int]:
+    """Per top-level scan: how many ppermute eqns naming ``axis`` its
+    body carries (sub-jaxprs included)."""
+    counts = []
+    for s in top_level_scans(jaxpr):
+        body = s.params["jaxpr"]
+        counts.append(sum(
+            1
+            for r in collective_census(body)
+            if r.primitive == "ppermute" and axis in r.axes
+        ))
+    return counts
+
+
+def schedule_findings(
+    jaxpr: Any,
+    sched: Any,
+    *,
+    axis_sizes: dict[str, int],
+    param_slices: Iterable[tuple[int, ...]] | None = None,
+    census: list[CollectiveRecord] | None = None,
+    label: str = "",
+    scale_bytes_per_call: int = SCALE_BYTES_PER_CALL,
+) -> list[Finding]:
+    """Check ``jaxpr`` against the expectations derived from ``sched``
+    (module docstring); returns error findings (empty = the program is
+    what the schedule declares).
+
+    ``axis_sizes`` are the resolved mesh axis sizes (rules on size-1 axes
+    lower to identity, so their checks are skipped). ``param_slices`` is
+    required when a block rule is declared on a populated axis
+    (``parallel.partition.block_param_slice_shapes``). ``census`` may be
+    passed to reuse an already-computed collective census.
+    """
+    if census is None:
+        census = collective_census(jaxpr)
+    out: list[Finding] = []
+    ring = sched.ring_gather()
+    block = sched.block_gather()
+
+    if ring is not None and axis_sizes.get(ring.axis, 1) > 1:
+        n = axis_sizes[ring.axis]
+        ring_recs = [
+            r for r in census
+            if r.primitive == "ppermute" and ring.axis in r.axes
+        ]
+        if not ring_recs:
+            out.append(Finding(
+                "schedule", "error", "missing-rings",
+                f"{label}schedule declares gather({ring.axis},ring_chunk) "
+                f"but the step carries no {ring.axis}-axis ppermute rings",
+                {"axis": ring.axis},
+            ))
+        hops = n - 1
+        for i, c in enumerate(_scan_axis_ppermute_counts(jaxpr, ring.axis)):
+            if c % hops != 0:
+                out.append(Finding(
+                    "schedule", "error", "broken-ring",
+                    f"{label}scan {i} carries {c} {ring.axis}-axis "
+                    f"ppermute eqn(s), not a whole number of "
+                    f"{hops}-hop chains over the {n}-way ring",
+                    {"axis": ring.axis, "scan": i, "count": c,
+                     "hops_per_chain": hops},
+                ))
+        if block is None:
+            # No blockwise rule ⇒ nothing may all_gather explicitly:
+            # activations (and everything else) ride the rings.
+            for shapes in primitive_shapes(jaxpr, "all_gather"):
+                out.append(Finding(
+                    "schedule", "error", "exposed-all-gather",
+                    f"{label}step carries an explicit all_gather of "
+                    f"{[list(s) for s in shapes]} — the schedule declares "
+                    "no blockwise gather; activations must ride the "
+                    "ppermute rings",
+                    {"shapes": [list(s) for s in shapes]},
+                ))
+        if ring.lowp is not None:
+            want = str(np.dtype(lowp_dtype(ring.lowp)))
+            wide = [
+                r for r in ring_recs
+                if r.dtype != want
+                and r.bytes_per_call > scale_bytes_per_call
+            ]
+            for r in wide:
+                out.append(Finding(
+                    "schedule", "error", "wide-ppermute",
+                    f"{label}lowp={ring.lowp} ring ppermutes a {r.dtype} "
+                    f"payload of {r.bytes_per_call} bytes/call (shapes "
+                    f"{[list(s) for s in r.shapes]}) — quantization "
+                    "silently fell back to wide floats",
+                    r.to_dict(),
+                ))
+            if not any(r.dtype == want for r in ring_recs):
+                out.append(Finding(
+                    "schedule", "error", "missing-lowp-rings",
+                    f"{label}schedule declares lowp={ring.lowp} but no "
+                    f"{want} ppermute payload exists on the "
+                    f"{ring.axis} axis",
+                    {"axis": ring.axis, "want_dtype": want},
+                ))
+
+    if block is not None and axis_sizes.get(block.axis, 1) > 1:
+        if param_slices is None:
+            raise ValueError(
+                "schedule_findings: a block gather rule on a populated "
+                "axis needs param_slices "
+                "(parallel.partition.block_param_slice_shapes)"
+            )
+        gathers = primitive_shapes(jaxpr, "all_gather")
+        if not gathers:
+            out.append(Finding(
+                "schedule", "error", "missing-block-gathers",
+                f"{label}schedule declares gather({block.axis},block) but "
+                "the step carries no explicit all_gather — param "
+                "gathering fell back to the GSPMD schedule",
+                {"axis": block.axis},
+            ))
+        out.extend(monolithic_gather_findings(
+            jaxpr, param_slices, label=label
+        ))
+        if sched.scatter_on(block.axis) is not None and not \
+                primitive_shapes(jaxpr, "reduce_scatter"):
+            out.append(Finding(
+                "schedule", "error", "missing-reduce-scatter",
+                f"{label}schedule declares scatter({block.axis}) but the "
+                "step has no explicit reduce_scatter — gradients leave "
+                "blocks gathered",
+                {"axis": block.axis},
+            ))
+        scans = top_level_scans(jaxpr)
+        if scans and not any(
+            len(primitive_shapes(s.params["jaxpr"], "all_gather")) > 0
+            for s in scans
+        ):
+            out.append(Finding(
+                "schedule", "error", "hoisted-gathers",
+                f"{label}no scan body carries the explicit gathers — "
+                "they were hoisted out of the layer loop",
+                {"axis": block.axis},
+            ))
+    return out

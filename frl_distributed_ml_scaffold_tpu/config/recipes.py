@@ -246,6 +246,51 @@ def gpt2_medium_tp_overlap_int8() -> ExperimentConfig:
     )
 
 
+@register_config("gpt2_medium_fsdp_tp_overlap")
+def gpt2_medium_fsdp_tp_overlap() -> ExperimentConfig:
+    """The composed overlap schedule (parallel/schedule.py, ROADMAP item
+    2's payoff case): BOTH explicit schedules in one scan body — params
+    full-sharded over ``fsdp`` with blockwise in-scan all-gather /
+    reduce-scatter (one-block-ahead prefetch), AND the four per-block TP
+    matmuls running as bidirectional collective-matmul ppermute rings
+    over ``model`` — with ZERO monolithic all_gathers in the step
+    (jaxpr-pinned via ``analysis.pins.assert_schedule``; the declared
+    schedule is ``gather(fsdp,block,prefetch=1)+scatter(fsdp)+
+    gather(model,ring_chunk)+scatter(model)``). Correctness is sim-gated
+    in tests/test_schedule.py (numerics vs the all-GSPMD fsdp x model
+    path, program identity vs the explicit declaration string); the
+    on-chip A/B rides ``tools/perf_sweep.py gpt2_fsdp_tp_overlap``
+    (BACKLOG relay window, next to R6-1/R7-1)."""
+    base = gpt2_medium_zero1()
+    return base.replace(
+        name="gpt2_medium_fsdp_tp_overlap",
+        mesh=MeshConfig(data=1, fsdp=-1, model=2),
+        parallel=ParallelConfig(
+            param_sharding="fsdp",
+            opt_sharding="like_params",
+            fsdp_overlap=True,
+            fsdp_prefetch=1,
+            tp_overlap=True,
+        ),
+    )
+
+
+@register_config("gpt2_medium_fsdp_tp_overlap_int8")
+def gpt2_medium_fsdp_tp_overlap_int8() -> ExperimentConfig:
+    """The composed schedule with low precision as a transfer attribute:
+    same blockwise fsdp gathers, but the model-axis rings ppermute int8
+    chunks + scales (``lowp=int8`` on the ring pair). Census-pinned via
+    ``assert_schedule`` to >= 3.5x lower model-axis ppermute bytes than
+    the fp32 composed path (4x element width minus scale traffic);
+    numerics tolerance-gated through the shared low-precision bands
+    (docs/perf_playbook.md "Low-precision fast path")."""
+    base = gpt2_medium_fsdp_tp_overlap()
+    return base.replace(
+        name="gpt2_medium_fsdp_tp_overlap_int8",
+        parallel=dataclasses.replace(base.parallel, low_precision="int8"),
+    )
+
+
 # ----- task-required parallelism showcases beyond the reference configs -----
 
 

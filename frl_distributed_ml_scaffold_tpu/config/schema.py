@@ -108,6 +108,19 @@ class ParallelConfig:
       is no GSPMD low-precision path to fall back to). Tolerances and
       when-to-use guidance: docs/perf_playbook.md "Low-precision fast
       path".
+    - ``schedule``: the unified overlap-schedule declaration
+      (parallel/schedule.py, ROADMAP item 2). "auto" (default) derives
+      the per-axis gather/scatter schedule from the knobs above —
+      ``fsdp_overlap``/``fsdp_prefetch`` become
+      ``gather(fsdp,block,prefetch=N)+scatter(fsdp)``,
+      ``tp_overlap``/``low_precision`` become
+      ``gather(model,ring_chunk[,lowp=FMT])+scatter(model[,lowp=FMT])``
+      — preserving their exact semantics. An explicit declaration string
+      in that grammar replaces the derivation (and must agree with any
+      legacy knob also set); contradictions raise a typed
+      ``ScheduleError`` naming the schedule attribute at Trainer
+      construction, never a shape error inside the scan body. Guidance:
+      docs/perf_playbook.md "Declaring an overlap schedule".
     """
 
     param_sharding: str = "replicated"  # replicated | fsdp
@@ -118,6 +131,9 @@ class ParallelConfig:
     fsdp_prefetch: int = 1
     tp_overlap: bool = False
     low_precision: str = "none"  # none | int8 | fp8_e4m3 | fp8_e5m2
+    # "auto" = derive from the knobs above; else an explicit declaration,
+    # e.g. "gather(fsdp,block,prefetch=1)+scatter(fsdp)".
+    schedule: str = "auto"
 
 
 @dataclass(frozen=True)

@@ -627,20 +627,23 @@ class Block(nn.Module):
 class GPT(nn.Module):
     config: GPTConfig
     policy: Policy
-    # Overlap-scheduled FSDP blockwise apply hook (parallel/fsdp_overlap.py
-    # OverlapHooks): when set, each scanned Block's param slice is
-    # explicitly all-gathered inside the scan body (nn.map_variables) and
-    # the block is rematted with a policy that refuses to save the gathered
-    # full params, so the backward re-gathers (reduce-scatter of grads is
-    # the gather's transpose). Attached by the Trainer AFTER partition
-    # specs exist; init/decode always run unhooked — the params tree is
-    # identical either way.
+    # Blockwise param-gather apply hook (fsdp_overlap.OverlapHooks —
+    # lowered from the declared OverlapSchedule's gather(fsdp,block) rule
+    # by parallel/schedule.py's executor): when set, each scanned Block's
+    # param slice is explicitly all-gathered inside the scan body
+    # (nn.map_variables) and the block is rematted with a policy that
+    # refuses to save the gathered full params, so the backward
+    # re-gathers (reduce-scatter of grads is the gather's transpose).
+    # Attached by the Trainer AFTER partition specs exist; init/decode
+    # always run unhooked — the params tree is identical either way.
     param_hooks: Any = None
-    # Collective-matmul TP schedule (parallel/tp_overlap.py TpHooks):
-    # replaces the four GSPMD TP matmuls per block (QKV, attn-out, fc_in,
-    # fc_out) with latency-hiding ppermute rings and keeps the residual
-    # stream sequence-sharded over the model axis. Attached by the Trainer
-    # like param_hooks; init/decode always run unhooked.
+    # Collective-matmul ring hooks (tp_overlap.TpHooks — lowered from the
+    # schedule's gather(model,ring_chunk)/scatter(model) pair, with any
+    # declared ``lowp`` riding as a transfer attribute): replaces the
+    # four GSPMD TP matmuls per block (QKV, attn-out, fc_in, fc_out)
+    # with latency-hiding ppermute rings and keeps the residual stream
+    # sequence-sharded over the model axis. Attached by the Trainer like
+    # param_hooks; init/decode always run unhooked.
     tp_overlap: Any = None
     # Decode KV-cache capacity (0 = config.seq_len). generate()/the
     # serving engine clone the model with the active bucket so the cache

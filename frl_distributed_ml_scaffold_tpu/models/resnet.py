@@ -216,8 +216,9 @@ class BasicBlock(nn.Module):
 class ResNet(nn.Module):
     config: ResNetConfig
     policy: Policy
-    # Overlap-scheduled FSDP blockwise apply hook (parallel/fsdp_overlap.py
-    # OverlapHooks): when set, each residual block's params are explicitly
+    # Blockwise param-gather apply hook (fsdp_overlap.OverlapHooks,
+    # lowered from the declared OverlapSchedule's gather(fsdp,block) rule
+    # by parallel/schedule.py): when set, each residual block's params are explicitly
     # all-gathered immediately before that block's compute — and the gather
     # of block k is tied (optimization_barrier) to the output of block
     # k - 1 - prefetch, which is the structurally enforced prefetch window

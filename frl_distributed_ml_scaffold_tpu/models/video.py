@@ -21,8 +21,10 @@ from frl_distributed_ml_scaffold_tpu.precision import Policy
 class VideoClassifier(nn.Module):
     config: VideoConfig
     policy: Policy
-    # Collective-matmul TP hooks (parallel/tp_overlap.py), attached by the
-    # Trainer for the loss path only (see vit.EncoderBlock).
+    # Collective-matmul ring hooks (tp_overlap.TpHooks, lowered from the
+    # declared OverlapSchedule's ring rule by parallel/schedule.py),
+    # attached by the Trainer for the loss path only (see
+    # vit.EncoderBlock).
     tp_overlap: Any = None
 
     @nn.compact

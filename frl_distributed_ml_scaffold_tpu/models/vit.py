@@ -125,9 +125,11 @@ class EncoderBlock(nn.Module):
 class ViT(nn.Module):
     config: ViTConfig
     policy: Policy
-    # Collective-matmul TP hooks (parallel/tp_overlap.py), attached by the
-    # Trainer for the loss path only — init always runs unhooked and the
-    # params tree is identical either way (see EncoderBlock).
+    # Collective-matmul ring hooks (tp_overlap.TpHooks, lowered from the
+    # declared OverlapSchedule's ring rule by parallel/schedule.py),
+    # attached by the Trainer for the loss path only — init always runs
+    # unhooked and the params tree is identical either way (see
+    # EncoderBlock).
     tp_overlap: Any = None
 
     @nn.compact

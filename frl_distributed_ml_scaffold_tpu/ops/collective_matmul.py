@@ -63,6 +63,7 @@ from frl_distributed_ml_scaffold_tpu.ops.quantization import (
     dequantize,
     qdot,
     quantize,
+    resolve_lowp,
 )
 
 
@@ -139,6 +140,9 @@ def _stream_ring(
 
     Returns ``(y, full, dw)`` with unused slots ``None``.
     """
+    # ``lowp`` is a schedule attribute (parallel/schedule.py): accept any
+    # knob spelling ("off"/"none"/None/format) via the shared vocabulary.
+    lowp = resolve_lowp(lowp)
     n = collectives.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     tc = x.shape[chunk_axis]
@@ -252,6 +256,7 @@ def _rotating_ring(
     pays repeated quantization (n-1 hops of ~qmax⁻¹ relative noise on
     the running sum; the accumulator itself stays fp32 between hops).
     """
+    lowp = resolve_lowp(lowp)
     n = collectives.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     tc = y.shape[chunk_axis] // n

@@ -58,6 +58,26 @@ def lowp_dtype(fmt: str):
     return LOWP_FORMATS[fmt][0]
 
 
+def resolve_lowp(value) -> str | None:
+    """Normalize any knob spelling of "which low-precision format" to a
+    canonical format name or None.
+
+    The overlap-schedule layer (parallel/schedule.py) declares ``lowp``
+    as a transfer attribute whose off spellings are ``None``/"none"/"off";
+    the ring ops and the TpHooks pass whatever the schedule carries
+    straight through here, so every consumer speaks one vocabulary.
+    Objects carrying a ``.lowp`` attribute (schedule rules) resolve to
+    that attribute. Unknown format names raise the ``lowp_dtype``
+    KeyError with the vocabulary listed.
+    """
+    if value is not None and hasattr(value, "lowp"):
+        value = value.lowp
+    if value is None or value in ("none", "off", ""):
+        return None
+    lowp_dtype(value)  # KeyError (with the vocabulary) on typos
+    return value
+
+
 def qmax(fmt: str) -> float:
     """Largest representable magnitude of a format."""
     lowp_dtype(fmt)

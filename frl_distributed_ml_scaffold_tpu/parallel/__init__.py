@@ -18,9 +18,18 @@ assignment over one mesh:
 
 from frl_distributed_ml_scaffold_tpu.parallel.partition import (
     PartitionRules,
+    block_param_slice_shapes,
     fsdp_spec_for,
     opt_state_specs,
     param_specs,
     shardings_from_specs,
 )
 from frl_distributed_ml_scaffold_tpu.parallel.pipeline import SpmdPipeline
+from frl_distributed_ml_scaffold_tpu.parallel.schedule import (
+    OverlapSchedule,
+    ScheduleError,
+    gather,
+    parse_schedule,
+    scatter,
+    schedule_from_config,
+)

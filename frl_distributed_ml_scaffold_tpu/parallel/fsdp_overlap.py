@@ -254,9 +254,13 @@ def overlap_remat_policy(block_remat: str = "none"):
     )
 
 
-def validate_overlap_config(cfg) -> None:
-    """Fail fast on configs the overlap path cannot honor (a silent
-    fallback to the GSPMD schedule would invalidate any A/B built on it)."""
+def validate_block_schedule(cfg, *, prefetch: int) -> None:
+    """Fail fast on configs a blockwise gather rule cannot honor (a silent
+    fallback to the GSPMD schedule would invalidate any A/B built on it).
+    Called by the schedule layer (parallel/schedule.py
+    ``validate_schedule_config``) for every ``granularity="block"``
+    gather; the legacy knob path reaches it through
+    ``validate_overlap_config``."""
     family = getattr(cfg.model, "family", None)
     if cfg.parallel.param_sharding != "fsdp":
         raise ValueError(
@@ -276,8 +280,27 @@ def validate_overlap_config(cfg) -> None:
             "with pipeline parallelism (the pipeline path owns its own "
             "block schedule); set model.pipeline_stages=1"
         )
-    if cfg.parallel.fsdp_prefetch < 0:
+    if prefetch < 0:
         raise ValueError(
-            f"parallel.fsdp_prefetch must be >= 0, got "
-            f"{cfg.parallel.fsdp_prefetch}"
+            f"parallel.fsdp_prefetch must be >= 0, got {prefetch}"
         )
+
+
+def validate_overlap_config(cfg) -> None:
+    """Legacy-knob adapter: validate ``parallel.fsdp_overlap=true`` by
+    deriving its schedule declaration and running the schedule layer's
+    checks (parallel/schedule.py owns the full contradiction set — e.g.
+    the prefetch-vs-block-count window bound)."""
+    from frl_distributed_ml_scaffold_tpu.parallel.schedule import (
+        OverlapSchedule,
+        gather,
+        scatter,
+        validate_schedule_config,
+    )
+
+    sched = OverlapSchedule.build(
+        gather("fsdp", granularity="block",
+               prefetch=cfg.parallel.fsdp_prefetch),
+        scatter("fsdp"),
+    )
+    validate_schedule_config(sched, cfg)

@@ -173,6 +173,36 @@ def opt_state_specs(
     return specs
 
 
+def block_param_slice_shapes(params_shapes: Any, model_axis: int) -> set[tuple]:
+    """Legal all_gather output shapes for a blockwise overlap schedule:
+    per-block slices of the stacked ``blocks`` params (scan-sliced —
+    leading layer dim dropped), or whole leaves for non-scanned families,
+    with Megatron-split dims also allowed at ``1/model_axis`` (the
+    per-shard view inside a composed schedule's shard_map regions).
+
+    This is the shape set ``analysis.pins.assert_schedule`` and the
+    graft-lint runner check blockwise gathers against — kept next to the
+    spec derivation so "which dims a block gather may move" has one owner.
+    """
+    import jax
+
+    slices: set[tuple] = set()
+    blocks = getattr(params_shapes, "get", lambda *_: None)("blocks")
+    leaves = jax.tree.leaves(blocks) if blocks is not None else []
+    if not leaves:  # non-scanned families: any full param leaf is a block
+        leaves = jax.tree.leaves(params_shapes)
+        for l in leaves:
+            slices.add(tuple(l.shape))
+    for l in leaves:
+        s = tuple(l.shape[1:]) if blocks is not None else tuple(l.shape)
+        slices.add(s)
+        if model_axis > 1:
+            for i, d in enumerate(s):
+                if d % model_axis == 0:
+                    slices.add(s[:i] + (d // model_axis,) + s[i + 1:])
+    return slices
+
+
 def shardings_from_specs(specs: Any, mesh: Mesh) -> Any:
     """PartitionSpec pytree → NamedSharding pytree."""
     return jax.tree.map(

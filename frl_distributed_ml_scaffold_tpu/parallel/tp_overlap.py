@@ -276,10 +276,13 @@ class _QkvContext:
 # ------------------------------------------------------------- validation
 
 
-def validate_tp_overlap_config(cfg) -> None:
+def validate_ring_schedule(cfg, *, lowp: str | None = None) -> None:
     """Fail fast on configs the collective-matmul schedule cannot honor
     (a silent fallback to the GSPMD TP schedule would invalidate any A/B
-    built on it) — the fsdp_overlap validation contract."""
+    built on it) — the fsdp_overlap validation contract. Called by the
+    schedule layer (parallel/schedule.py ``validate_schedule_config``)
+    for every ``granularity="ring_chunk"`` gather; the legacy knob path
+    reaches it through ``validate_tp_overlap_config``."""
     family = getattr(cfg.model, "family", None)
     if family not in SUPPORTED_FAMILIES:
         raise ValueError(
@@ -311,19 +314,54 @@ def validate_tp_overlap_config(cfg) -> None:
             "hooks (its dispatch owns the token exchange); set "
             "model.moe.num_experts=0"
         )
-    lp = getattr(cfg.parallel, "low_precision", "none")
-    if lp != "none":
+    if lowp is not None:
         from frl_distributed_ml_scaffold_tpu.ops.quantization import (
             lowp_dtype,
         )
 
-        lowp_dtype(lp)  # KeyError (with the vocabulary) on typos
+        lowp_dtype(lowp)  # KeyError (with the vocabulary) on typos
+
+
+def validate_tp_overlap_config(cfg) -> None:
+    """Legacy-knob adapter: validate ``parallel.tp_overlap=true`` by
+    deriving its schedule declaration and running the schedule layer's
+    checks (the ``low_precision`` knob becomes the ring pair's ``lowp``
+    transfer attribute)."""
+    from frl_distributed_ml_scaffold_tpu.ops.quantization import resolve_lowp
+    from frl_distributed_ml_scaffold_tpu.parallel.schedule import (
+        OverlapSchedule,
+        gather,
+        scatter,
+        validate_schedule_config,
+    )
+
+    lowp = resolve_lowp(getattr(cfg.parallel, "low_precision", "none"))
+    sched = OverlapSchedule.build(
+        gather("model", granularity="ring_chunk", lowp=lowp),
+        scatter("model", lowp=lowp),
+    )
+    validate_schedule_config(sched, cfg)
 
 
 def make_tp_hooks(cfg, env) -> TpHooks:
     """Build the hooks for a resolved mesh, validating what only the mesh
-    knows (axis size, chunk divisibility)."""
-    validate_tp_overlap_config(cfg)
+    knows (axis size, chunk divisibility). ``lowp`` comes from the
+    config's RESOLVED schedule declaration (parallel/schedule.py) — low
+    precision is a transfer attribute of the declared ring, whether the
+    ring was requested via the legacy ``tp_overlap``/``low_precision``
+    knobs or an explicit ``parallel.schedule`` string."""
+    from frl_distributed_ml_scaffold_tpu.ops.quantization import resolve_lowp
+    from frl_distributed_ml_scaffold_tpu.parallel.schedule import (
+        schedule_from_config,
+    )
+
+    sched = schedule_from_config(cfg)
+    ring = sched.ring_gather() if sched is not None else None
+    lowp = (
+        ring.lowp if ring is not None
+        else resolve_lowp(getattr(cfg.parallel, "low_precision", "none"))
+    )
+    validate_ring_schedule(cfg, lowp=lowp)
     m = env.axis_size("model")
     if m <= 1:
         raise ValueError(
@@ -344,8 +382,6 @@ def make_tp_hooks(cfg, env) -> TpHooks:
             "the collective-matmul rings split the Megatron feature dims "
             "exactly, without GSPMD's padding"
         )
-    lowp = getattr(cfg.parallel, "low_precision", "none")
-    lowp = None if lowp == "none" else lowp
     # num_heads need NOT divide by m: the attention segment between the
     # rings stays GSPMD-owned (head-split F is just a feature dim to it,
     # and it pads/reshards as it always did — equivalence is gated at

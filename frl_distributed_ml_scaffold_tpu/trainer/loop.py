@@ -119,28 +119,23 @@ class Trainer:
                 "opt_sharding=like_params) on a mesh with model=1, "
                 "expert=1 and pipe=1; use adamw for sharded-state configs"
             )
-        if cfg.parallel.fsdp_overlap:
-            from frl_distributed_ml_scaffold_tpu.parallel.fsdp_overlap import (
-                validate_overlap_config,
-            )
+        # The unified overlap-schedule layer (parallel/schedule.py,
+        # ROADMAP item 2): derive the declared per-axis gather/scatter
+        # schedule — from the legacy fsdp_overlap/tp_overlap/low_precision
+        # knobs or an explicit parallel.schedule string — and refuse
+        # contradictory declarations HERE, with a typed ScheduleError
+        # naming the attribute, instead of as shape errors in the scan
+        # body. (lowp without a ring axis, prefetch out of window, and
+        # the per-mechanism family/pipeline/sequence checks all live in
+        # schedule_from_config/validate_schedule_config.)
+        from frl_distributed_ml_scaffold_tpu.parallel.schedule import (
+            schedule_from_config,
+            validate_schedule_config,
+        )
 
-            validate_overlap_config(cfg)
-        if cfg.parallel.tp_overlap:
-            from frl_distributed_ml_scaffold_tpu.parallel.tp_overlap import (
-                validate_tp_overlap_config,
-            )
-
-            validate_tp_overlap_config(cfg)
-        elif cfg.parallel.low_precision != "none":
-            # The knob quantizes the collective-matmul rings; without them
-            # it would silently change nothing — the fsdp_overlap/tp_overlap
-            # "no silent fallback" contract.
-            raise ValueError(
-                f"parallel.low_precision={cfg.parallel.low_precision!r} "
-                "requires parallel.tp_overlap=true (the low-precision fast "
-                "path lives in the collective-matmul rings; there is no "
-                "GSPMD low-precision schedule to fall back to)"
-            )
+        self.overlap_schedule = schedule_from_config(cfg)
+        if self.overlap_schedule is not None:
+            validate_schedule_config(self.overlap_schedule, cfg)
         self.env = mesh_env if mesh_env is not None else build_mesh(cfg.mesh)
         self.policy = get_policy(cfg.precision)
         self.model = create_model(cfg.model, self.policy)
@@ -159,16 +154,11 @@ class Trainer:
             )
 
         self._build_state_shardings()
-        if cfg.parallel.fsdp_overlap:
+        if self.overlap_schedule is not None:
             # Hooks need the partition specs, so they attach only after
             # the (unhooked) model produced the state shapes above; the
             # params tree is identical with hooks on or off.
-            self._attach_overlap_hooks()
-        if cfg.parallel.tp_overlap:
-            # Composes with fsdp_overlap: the TP hooks stack onto whichever
-            # model currently backs the loss (the fsdp-hooked clone when
-            # both schedules are on).
-            self._attach_tp_hooks()
+            self._attach_schedule()
         self._compile_steps()
 
     # ---------------------------------------------------------------- setup
@@ -245,61 +235,34 @@ class Trainer:
         self.state_shapes = state_shapes
         self._rng = rng
 
-    def _attach_overlap_hooks(self) -> None:
-        """Rebind the model + loss_fn to the overlap-scheduled FSDP path
-        (parallel/fsdp_overlap.py): explicit per-block all-gather of
-        sharded params / reduce-scatter of grads, prefetched one block
-        ahead. Requires the partition specs from _build_state_shardings."""
-        from jax.sharding import PartitionSpec as P
-
-        from frl_distributed_ml_scaffold_tpu.parallel.fsdp_overlap import (
-            OverlapHooks,
-            make_scan_block_hook,
-            make_shape_hook_factory,
-            strip_scan_dim,
+    def _attach_schedule(self) -> None:
+        """Rebind the loss model to the declared overlap schedule
+        (parallel/schedule.py ``hooked_model``): a blockwise fsdp gather
+        rule lowers to the explicit per-block all-gather / reduce-scatter
+        hooks, a ring-chunk model rule to the collective-matmul ppermute
+        rings — both stacked onto one clone when the schedule declares
+        both axes, so the gathers and rings overlap in the same scan
+        body. Hooked clone for APPLY only (train/eval loss): the hook
+        mechanisms cannot create params, so init/eval_shape keep the
+        plain self.model — the params tree is identical either way.
+        Requires the partition specs from _build_state_shardings."""
+        from frl_distributed_ml_scaffold_tpu.parallel.schedule import (
+            hooked_model,
         )
 
-        cfg = self.cfg
-        prefetch = cfg.parallel.fsdp_prefetch
-        if cfg.model.family == "gpt":
-            # The scanned stack's hook gathers one layer's SLICE per scan
-            # iteration; its specs are the stacked specs minus the layer dim.
-            sliced = jax.tree.map(
-                strip_scan_dim,
-                self.state_specs.params["blocks"],
-                is_leaf=lambda t: isinstance(t, P),
-            )
-            hooks = OverlapHooks(
-                prefetch=prefetch, block_hook=make_scan_block_hook(sliced)
-            )
-        else:  # resnet (validate_overlap_config gates the families)
-            hooks = OverlapHooks(
-                prefetch=prefetch,
-                hook_factory=make_shape_hook_factory(
-                    cfg.parallel, self.env.axis_size("fsdp")
-                ),
-            )
-        # Hooked clone for APPLY only (train/eval loss): map_variables
-        # cannot create params, so init/eval_shape keep the plain
-        # self.model — the params tree is identical either way.
-        self._overlap_model = self.model.clone(param_hooks=hooks)
-        self.loss_fn = make_loss_fn(self._overlap_model, cfg.data.name)
-
-    def _attach_tp_hooks(self) -> None:
-        """Rebind the loss model to the collective-matmul TP schedule
-        (parallel/tp_overlap.py): the four per-block TP matmuls become
-        latency-hiding ppermute rings. Stacks onto the fsdp_overlap clone
-        when both schedules are on; init/decode keep the plain model (the
-        params tree is identical either way)."""
-        from frl_distributed_ml_scaffold_tpu.parallel.tp_overlap import (
-            make_tp_hooks,
+        model = hooked_model(
+            self.overlap_schedule, self.model, self.cfg, self.env,
+            self.state_specs.params,
         )
-
-        cfg = self.cfg
-        hooks = make_tp_hooks(cfg, self.env)
-        base = getattr(self, "_overlap_model", None) or self.model
-        self._tp_model = base.clone(tp_overlap=hooks)
-        self.loss_fn = make_loss_fn(self._tp_model, cfg.data.name)
+        if self.overlap_schedule.block_gather() is not None:
+            # Kept for introspection/back-compat: the fsdp-hooked clone
+            # (without the ring hooks when both are declared).
+            self._overlap_model = self.model.clone(
+                param_hooks=model.param_hooks
+            )
+        if self.overlap_schedule.ring_gather() is not None:
+            self._tp_model = model
+        self.loss_fn = make_loss_fn(model, self.cfg.data.name)
 
     def _mesh_scoped(self, fn):
         """Run ``fn`` with this trainer's mesh as the ambient context.

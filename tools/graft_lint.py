@@ -2,10 +2,13 @@
 """graft-lint CLI: enforce the repo's performance invariants statically.
 
 Lints every registered recipe's train step (trace-only: jaxpr + lowered
-StableHLO, no XLA compile), the serving decode step, and the traced
-modules' Python source, then emits a JSON report and exits non-zero on
-any ``severity:error`` finding.  CPU-sim safe: forces JAX_PLATFORMS=cpu
-with 8 virtual devices, the same harness as the test suite.
+StableHLO, no XLA compile), the ``schedule:`` program family (every
+overlap recipe's train step re-checked against the expectations DERIVED
+from its declared ``parallel/schedule.py`` OverlapSchedule — ISSUE 13),
+the serving decode step, and the traced modules' Python source, then
+emits a JSON report and exits non-zero on any ``severity:error``
+finding.  CPU-sim safe: forces JAX_PLATFORMS=cpu with 8 virtual
+devices, the same harness as the test suite.
 
     python tools/graft_lint.py --all-recipes            # the CI gate
     python tools/graft_lint.py --recipe gpt2_medium_tp_overlap

@@ -10,6 +10,10 @@ TTFT/TPOT from a tiny serve run) into one per-recipe attribution row:
 - ``flops_per_step`` / ``collective_bytes_per_step`` / arithmetic
   intensity, and the roofline verdict (compute- vs comm-bound at the
   configured peaks);
+- the recipe's DECLARED overlap schedule (parallel/schedule.py
+  ``describe()`` — rows are per-schedule, not per-recipe: what the step
+  declares about its gathers/scatters/lowp gates together with the
+  census that declaration produces; "gspmd" for plain recipes);
 - measured ``step_time_p50_s``, achieved FLOP/s, and MFU — so "where did
   the time go" has an analytic denominator next to every measured number.
 
@@ -55,10 +59,14 @@ sys.path.insert(0, _REPO)
 DEFAULT_BASELINE = os.path.join(_REPO, "PERF_LEDGER.json")
 
 #: The committed tiny-recipe set: one replicated-DDP recipe (census is
-#: empty at the jaxpr level — GSPMD owns its collectives) and one
-#: explicit-schedule recipe (the ppermute rings ARE the census). Small
-#: enough that --check stays inside the lint tier's budget.
-DEFAULT_RECIPES = ("mnist_mlp", "gpt2_medium_tp_overlap")
+#: empty at the jaxpr level — GSPMD owns its collectives), one
+#: explicit-schedule recipe (the ppermute rings ARE the census), and the
+#: composed fsdp x TP overlap schedule (ISSUE 13 — blockwise gathers AND
+#: rings in one scan body). Small enough that --check stays inside the
+#: lint tier's budget.
+DEFAULT_RECIPES = (
+    "mnist_mlp", "gpt2_medium_tp_overlap", "gpt2_medium_fsdp_tp_overlap",
+)
 
 SERVING_PROGRAM = "serving:decode_step"
 PAGED_SERVING_PROGRAM = "serving:decode_step_paged"
@@ -67,13 +75,18 @@ HANDOFF_PROGRAM = "serving:handoff"
 
 #: Analytic row fields --check compares EXACTLY. Everything else in a row
 #: (intensity, roofline, measured) is either derived from these or
-#: measured wall time.
+#: measured wall time. ``schedule`` makes the rows per-SCHEDULE (ISSUE
+#: 13): each row carries its recipe's declared OverlapSchedule
+#: descriptor, so a change to WHAT a recipe declares (axes, granularity,
+#: prefetch, lowp) gates exactly like a change to the census the
+#: declaration produces.
 ANALYTIC_KEYS = (
     "flops_per_step",
     "collective_bytes_per_step",
     "collectives",
     "params_bytes",
     "chips",
+    "schedule",
 )
 
 
@@ -131,6 +144,10 @@ def analytic_recipe_row(name: str, workdir: str) -> dict:
     )
     from frl_distributed_ml_scaffold_tpu.utils.flops import jaxpr_flops
 
+    from frl_distributed_ml_scaffold_tpu.parallel.schedule import (
+        schedule_from_config,
+    )
+
     trainer = _build_trainer(name, workdir)
     batch = _abstract_batch(trainer)
     jaxpr = trainer._mesh_scoped(jax.make_jaxpr(trainer._train_step_fn))(
@@ -140,6 +157,11 @@ def analytic_recipe_row(name: str, workdir: str) -> dict:
     flops = jaxpr_flops(jaxpr)
     comm = sum(r.total_bytes for r in census)
     chips = jax.device_count()
+    # Rows are per-SCHEDULE (ISSUE 13): the declared OverlapSchedule
+    # descriptor rides next to the census it is supposed to produce, and
+    # --check gates both together. Recipes with no overlap declaration
+    # record the GSPMD schedule explicitly.
+    sched = schedule_from_config(trainer.cfg)
     return {
         "flops_per_step": flops,
         "collective_bytes_per_step": comm,
@@ -148,6 +170,10 @@ def analytic_recipe_row(name: str, workdir: str) -> dict:
         },
         "params_bytes": _tree_bytes(trainer.state_shapes.params),
         "chips": chips,
+        "schedule": (
+            sched.describe() if sched is not None
+            else {"declared": "gspmd", "short": "gspmd"}
+        ),
         "intensity_flops_per_byte": round(flops / max(comm, 1), 3),
         "roofline": _roofline(flops, comm, chips),
     }
@@ -488,8 +514,16 @@ def render(ledger: dict, out=sys.stdout) -> None:
     if not rows:
         return
     width = max(len(p) for p in rows)
+    swidth = max(
+        [len("schedule")]
+        + [
+            len((r.get("schedule") or {}).get("short", "-"))
+            for r in rows.values()
+        ]
+    )
     print(
-        f"  {'program':<{width}s} {'flops/step':>12s} {'comm B/step':>12s} "
+        f"  {'program':<{width}s} {'schedule':<{swidth}s} "
+        f"{'flops/step':>12s} {'comm B/step':>12s} "
         f"{'F/B':>10s} {'bound':>8s} {'p50 step s':>11s} {'mfu':>9s}",
         file=out,
     )
@@ -497,8 +531,10 @@ def render(ledger: dict, out=sys.stdout) -> None:
         measured = r.get("measured") or {}
         t = measured.get("step_time_p50_s", measured.get("tpot_p50_s", 0.0))
         mfu = (r.get("attribution") or {}).get("mfu", 0.0)
+        sched = (r.get("schedule") or {}).get("short", "-")
         print(
-            f"  {program:<{width}s} {r['flops_per_step']:>12.3e} "
+            f"  {program:<{width}s} {sched:<{swidth}s} "
+            f"{r['flops_per_step']:>12.3e} "
             f"{r['collective_bytes_per_step']:>12d} "
             f"{r['intensity_flops_per_byte']:>10.1f} "
             f"{r['roofline']['bound']:>8s} "

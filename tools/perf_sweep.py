@@ -438,6 +438,54 @@ def rn50_fused_opt():
         emit("rn50_fused_opt", 512, dt, {"optimizer": opt})
 
 
+def gpt2_fsdp_tp_overlap():
+    """The composed-schedule A/B (ISSUE 13, queued for the next
+    multi-chip relay window alongside R6-1/R7-1): the unified overlap
+    schedule with BOTH axes declared — blockwise fsdp gathers AND
+    model-axis collective-matmul rings in one scan body
+    (gpt2_medium_fsdp_tp_overlap) — vs the all-GSPMD fsdp x model
+    schedule, plus the int8 transfer arm (lowp as a schedule attribute).
+    Needs >= 4 devices (a real fsdp axis x model=2); on a smaller relay
+    it emits a skip row. Correctness is sim-gated (tests/test_schedule.py
+    numerics grid + assert_schedule jaxpr/census pins); this measures
+    whether the composed explicit schedules hide BOTH collective classes
+    at once — capture a trace and read tools/trace_analyze.py's
+    per-class overlap summary (all-gather AND collective-permute hidden
+    vs exposed)."""
+    import jax
+
+    n = jax.device_count()
+    if n < 4:
+        print(json.dumps({
+            "experiment": "gpt2_fsdp_tp_overlap",
+            "skipped": f"needs >=4 devices for fsdp x model (have {n})",
+        }), flush=True)
+        return
+    base = [
+        "trainer.grad_accum=1",
+        "trainer.remat=none",
+        "model.block_remat=full",
+        "mesh.data=1",
+        f"mesh.fsdp={n // 2}",
+        "mesh.model=2",
+    ]
+    for overlap, lowp in (("false", "none"), ("true", "none"),
+                          ("true", "int8")):
+        for per_chip in (4, 8):
+            bs = per_chip * n
+            measure_or_emit(
+                "gpt2_fsdp_tp_overlap", bs, "gpt2_medium_fsdp_tp_overlap",
+                base + [
+                    f"parallel.fsdp_overlap={overlap}",
+                    f"parallel.tp_overlap={overlap}",
+                    f"parallel.low_precision={lowp}",
+                    f"data.global_batch_size={bs}",
+                ],
+                {"overlap": overlap, "lowp": lowp, "n_chips": n},
+                n=10, warm=3,
+            )
+
+
 def rn50_fused_bn():
     """The priced HBM-ceiling fix, bought (BACKLOG R5-4): the roofline
     pins ~150 ms of the 227 ms headline step in BN-backward HBM traffic
@@ -461,7 +509,7 @@ GROUPS = {f.__name__: f for f in (rn50_bs, rn50_precision, rn50_fwd_only,
                                   gpt2_block_remat, gpt2_offload,
                                   rn50_fused_opt, rn50_fused_bn,
                                   moe_dispatch, gpt2_fsdp_overlap,
-                                  gpt2_tp_overlap)}
+                                  gpt2_tp_overlap, gpt2_fsdp_tp_overlap)}
 
 if __name__ == "__main__":
     which = sys.argv[1:] or list(GROUPS)
