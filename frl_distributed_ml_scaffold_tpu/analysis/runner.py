@@ -140,6 +140,7 @@ RECIPE_OVERRIDES: dict[str, list[str]] = {
     ],
     "gpt2_pp": _PP_TINY + ["mesh.pipe=4", "mesh.data=2"],
     "gpt2_pp_circular": _PP_TINY + ["mesh.pipe=4", "mesh.data=2"],
+    "gpt2_pipeline_mpmd": _PP_TINY + ["mesh.pipe=4", "mesh.data=2"],
     "gpt2_medium_serve": _GPT_TINY + ["mesh.data=4", "mesh.model=2"],
 }
 
@@ -208,6 +209,11 @@ def _lint_recipe_reports(
     report = Report(program=f"recipe:{name}")
     trainer = _build_trainer(name, workdir)
     cfg = trainer.cfg
+    if getattr(trainer, "_mpmd", None) is not None:
+        # MPMD pipeline recipes (ISSUE 14) have no single train-step
+        # program: the recipe report AND the pipeline:stage_program
+        # family both come from the per-stage artifacts.
+        return _lint_mpmd_reports(name, trainer)
     state_shapes = trainer.state_shapes
     batch = _abstract_batch(trainer)
 
@@ -348,6 +354,142 @@ def _lint_recipe_reports(
             "the donation",
         )
     return [report] + ([sched_report] if sched_report else [])
+
+
+def _stage_program_findings(report: Report, arts, *, label: str = "") -> None:
+    """The ``pipeline:stage_program`` invariants (ISSUE 14), over the
+    runner's abstract per-stage artifacts:
+
+    - **No cross-stage collectives.** A per-stage program may collect
+      over its submesh's data/fsdp/model/seq axes (grad reductions, fsdp
+      gathers, TP rings, ring attention) but NEVER over ``pipe`` —
+      boundary traffic is the driver's explicit ``device_put`` transfers
+      only. Any ``pipe``-axis collective means a stage program started
+      reaching across the stage boundary (error
+      ``cross-stage-collective``).
+    - **Stage state donated.** The per-stage update program donates every
+      params/opt-state leaf (and the EMA mirror when on) — the per-stage
+      face of the train step's ``donate_argnums=(0,)``; a dropped
+      donation doubles stage state residency (error
+      ``stage-not-donated``).
+    """
+    from frl_distributed_ml_scaffold_tpu.analysis.donation import (
+        args_info_donations,
+        lowered_donations,
+    )
+
+    census_all = []
+    for art in arts:
+        j = art["stage"]
+        for which in ("fwd_jaxpr", "fwd_bwd_jaxpr"):
+            census = collective_census(art[which])
+            census_all.extend(r.to_dict() for r in census)
+            for r in census:
+                if "pipe" in r.axes:
+                    report.add(
+                        "stage_program", "error", "cross-stage-collective",
+                        f"{label}stage {j} {which.replace('_jaxpr', '')} "
+                        f"program carries a {r.primitive} over the pipe "
+                        f"axis {r.axes} — inter-stage traffic must be the "
+                        "driver's explicit transfers, never a collective "
+                        "inside a stage program",
+                        stage=j, primitive=r.primitive, axes=list(r.axes),
+                    )
+        lowered = art["update_lowered"]
+        pairs = args_info_donations(lowered)
+        if pairs is None:
+            dons = [d.donated for d in lowered_donations(lowered.as_text())]
+            if not any(dons):
+                report.add(
+                    "donation", "error", "stage-not-donated",
+                    f"{label}stage {j} update program carries no donation "
+                    "marker — stage params/opt-state double per step",
+                    stage=j,
+                )
+            continue
+        # Every state-carrying update arg must be donated: params, opt
+        # state, grads — and the EMA mirror when on (the runner records
+        # which positions those are; only the clip-factor scalar is
+        # legally un-donated).
+        expected = tuple(
+            f"[0][{i}]" for i in art.get("update_donate_expected", (0, 1))
+        )
+        undonated = [
+            p for p, d in pairs if p.startswith(expected) and not d
+        ]
+        for p in undonated:
+            report.add(
+                "donation", "error", "stage-not-donated",
+                f"{label}stage {j} update program does not donate state "
+                f"leaf {p} — stage params/opt-state double per step",
+                stage=j, path=p,
+            )
+    report.meta["collective_census"] = census_all
+    report.meta["stages"] = len(arts)
+    if report.ok:
+        report.add(
+            "stage_program", "info", "summary",
+            f"{label}{len(arts)} per-stage programs are free of "
+            "cross-stage collectives and donate their stage state",
+        )
+
+
+def _lint_mpmd_reports(name: str, trainer) -> list[Report]:
+    """Recipe + ``pipeline:stage_program`` family reports for an MPMD
+    pipeline recipe — one artifact build, two views (the schedule:
+    family pattern)."""
+    from frl_distributed_ml_scaffold_tpu.parallel.mpmd_pipeline import (
+        bubble_fraction,
+        peak_live_activations,
+    )
+
+    runner = trainer._mpmd
+    arts = runner.lint_artifacts()
+    report = Report(program=f"recipe:{name}")
+    report.meta["pipeline"] = {
+        "impl": "mpmd",
+        "stages": runner.num_stages,
+        "microbatches": runner.total_micro,
+        "bubble_fraction": bubble_fraction(
+            "1f1b", runner.num_stages, runner.total_micro
+        ),
+        "peak_live_activations": peak_live_activations(
+            "1f1b", runner.num_stages, runner.total_micro
+        ),
+    }
+    _stage_program_findings(report, arts, label=f"{name}: ")
+    # The stage_program family rides the SAME pass output — no second
+    # census/donation walk over identical artifacts (the schedule:
+    # family pattern).
+    stage_report = Report(program="pipeline:stage_program")
+    stage_report.meta["recipe"] = name
+    stage_report.meta["pipeline"] = report.meta["pipeline"]
+    stage_report.meta["collective_census"] = report.meta[
+        "collective_census"
+    ]
+    stage_report.meta["stages"] = report.meta["stages"]
+    stage_report.extend(report.findings)
+    return [report, stage_report]
+
+
+def lint_stage_programs(
+    name: str = "gpt2_pipeline_mpmd", *, workdir: str = "/tmp/graft_lint"
+) -> Report:
+    """The ``pipeline:stage_program`` program family (ISSUE 14) on its
+    own: per-stage programs of the MPMD pipeline recipe pinned free of
+    cross-stage collectives, stage params/opt-state donation audited.
+    Shares the recipe build with ``_lint_mpmd_reports``; mutation-gated
+    in tests/test_graft_lint.py."""
+    trainer = _build_trainer(name, workdir)
+    if getattr(trainer, "_mpmd", None) is None:
+        report = Report(program="pipeline:stage_program")
+        report.add(
+            "stage_program", "error", "not-mpmd",
+            f"{name}: recipe does not run the MPMD pipeline backend — "
+            "the stage_program family needs pipeline_impl='mpmd'",
+        )
+        return report
+    return _lint_mpmd_reports(name, trainer)[1]
 
 
 def lint_train_step(

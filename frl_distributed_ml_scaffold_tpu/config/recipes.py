@@ -377,6 +377,24 @@ def gpt2_pp() -> ExperimentConfig:
     )
 
 
+@register_config("gpt2_pipeline_mpmd")
+def gpt2_pipeline_mpmd() -> ExperimentConfig:
+    """MPMD pipeline parallelism (ISSUE 14): the ``gpt2_pp`` operating
+    point on the per-stage-program backend (parallel/mpmd_pipeline.py) —
+    each of the 4 stages is its own jitted program on its pipe-slice
+    submesh, a host-side 1F1B driver moves activations/gradients as
+    explicit ``device_put`` transfers, and steady state holds min(S, M)=4
+    in-flight microbatch activations instead of GPipe's 8. Loss/token
+    parity with the SPMD backend is sim-gated in
+    tests/test_mpmd_pipeline.py; the step-time A/B rides
+    ``tools/perf_sweep.py gpt2_pipeline_mpmd`` (BACKLOG R17-1)."""
+    base = gpt2_pp()
+    return base.replace(
+        name="gpt2_pipeline_mpmd",
+        model=dataclasses.replace(base.model, pipeline_impl="mpmd"),
+    )
+
+
 @register_config("gpt2_pp_circular")
 def gpt2_pp_circular() -> ExperimentConfig:
     """Circular (interleaved) pipeline: same 4 physical stages as

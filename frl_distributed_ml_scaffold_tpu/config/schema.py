@@ -527,6 +527,26 @@ class GPTConfig:
     # stages" (the minimum that keeps every stage busy outside the bubble).
     pipeline_stages: int = 1
     pipeline_microbatches: int = 0
+    # Pipeline backend (ISSUE 14):
+    #   "spmd" — the stage-vmap GPipe schedule (parallel/pipeline.py): the
+    #            whole timeline is ONE compiled GSPMD program; all M
+    #            microbatch activations stay live across the tick scan.
+    #   "mpmd" — per-stage programs (parallel/mpmd_pipeline.py, the MPMD
+    #            pipeline-parallelism formulation of arXiv 2412.14374):
+    #            each stage is its own jitted program on its pipe-slice
+    #            submesh with stage-local params/optimizer shards (no
+    #            leading [S, ...] vmap dim), driven by a host-side 1F1B
+    #            scheduler with EXPLICIT inter-stage activation/gradient
+    #            transfers — steady-state holds only min(S, M) in-flight
+    #            microbatch activations instead of M, there is no
+    #            vmap(spmd_axis_name) lowering (so sequence-parallel
+    #            ring/ulysses attention composes — BACKLOG R8-2), and the
+    #            per-stage-program shape is the multi-slice scale-out
+    #            substrate. ``pipeline_stages``/``pipeline_microbatches``
+    #            keep their meaning (``effective_microbatches`` is still
+    #            the one resolution rule); grad accumulation folds into
+    #            the same 1F1B run as additional microbatches.
+    pipeline_impl: str = "spmd"  # spmd | mpmd
     # Circular (interleaved) schedule: each physical stage holds this many
     # non-adjacent layer groups ("virtual stages"), cutting the GPipe bubble
     # from (S-1)/(M+S-1) to (S-1)/(repeat*M + S-1) at the price of rotating

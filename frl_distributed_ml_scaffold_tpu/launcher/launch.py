@@ -317,9 +317,17 @@ def describe(cfg) -> int:
     )
     batch = {k: np.asarray(v) for k, v in x.items()}
     try:
-        flops = trainer._mesh_scoped(fn_flops)(
-            trainer._train_step_fn, trainer.state_shapes, batch
-        )
+        if getattr(trainer, "_mpmd", None) is not None:
+            # MPMD pipeline: no single train-step program — sum the
+            # per-stage fwd+bwd jaxpr FLOPs over all microbatches.
+            cost = trainer._mpmd.step_cost_analysis()
+            if cost is None:
+                raise RuntimeError("per-stage FLOPs unavailable")
+            flops = float(cost["flops"])
+        else:
+            flops = trainer._mesh_scoped(fn_flops)(
+                trainer._train_step_fn, trainer.state_shapes, batch
+            )
         per_sample = flops / batch[next(iter(batch))].shape[0]
         print(f"train-step FLOPs (example batch): {flops / 1e9:.2f} G "
               f"({per_sample / 1e9:.2f} G/sample)")

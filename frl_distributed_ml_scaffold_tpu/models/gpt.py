@@ -67,6 +67,203 @@ def gpt_tp_rules(pipelined: bool = False, circular: bool = False) -> PartitionRu
     return PartitionRules(rules=rules)
 
 
+def _train_block_stack(cfg: GPTConfig, *, length: int, hooks=None):
+    """The scanned TRAINING-mode Block stack: blockwise param-gather hook
+    (``nn.map_variables``) + per-block remat wrap + ``nn.scan``, shared by
+    the monolithic ``GPT`` and the per-stage ``GptStage`` (MPMD pipeline,
+    ISSUE 14) so the two paths cannot drift. Returns the transformed
+    CLASS; the caller instantiates it with ``name="blocks"`` (decode
+    builds its own plain scan — caches/hooks never mix)."""
+    block_cls = Block
+    if hooks is not None:
+        # Gather INSIDE the scan body (one layer's slice per iteration —
+        # the blockwise schedule) and inside the remat region below (so
+        # recompute re-gathers instead of saving full params).
+        # map_variables(init=False): param creation still sees the raw
+        # sharded tree, keeping init and checkpoint layouts identical to
+        # the unhooked model.
+        block_cls = nn.map_variables(
+            block_cls,
+            "params",
+            trans_in_fn=hooks.block_hook,
+            init=False,
+        )
+    if cfg.block_remat != "none" or hooks is not None:
+        # Per-layer remat (config 3's activation checkpointing at the
+        # granularity that matters under nn.scan): checkpoint each
+        # scanned body so the backward re-derives one block's internals
+        # at a time instead of holding all L layers'. prevent_cse=False
+        # is the documented setting under scan — the scan boundary
+        # already stops the CSE that remat's default guards against, and
+        # leaving it True blocks XLA optimizations for nothing.
+        if hooks is not None:
+            # Same three modes, with gathered params always excluded
+            # from the saved set (GATHER_NAME tag).
+            from frl_distributed_ml_scaffold_tpu.parallel.fsdp_overlap import (
+                overlap_remat_policy,
+            )
+
+            policy = overlap_remat_policy(cfg.block_remat)
+        elif cfg.block_remat == "full":
+            policy = None
+        elif cfg.block_remat == "save_attn":
+            policy = jax.checkpoint_policies.save_only_these_names(
+                "attn_out"
+            )
+        else:
+            raise KeyError(
+                f"unknown model.block_remat={cfg.block_remat!r} "
+                "(none | full | save_attn)"
+            )
+        block_cls = nn.remat(block_cls, prevent_cse=False, policy=policy)
+    return nn.scan(
+        block_cls,
+        length=length,
+        variable_axes={"params": 0, "cache": 0},
+        split_rngs={"params": True, "dropout": True},
+    )
+
+
+def mpmd_stage_params(cfg: GPTConfig, params, num_stages: int):
+    """Slice a PLAIN-layout GPT params tree into per-stage trees for the
+    MPMD pipeline backend (ISSUE 14): ``{"stage_j": ...}`` where stage
+    ``j`` owns ``blocks`` leaves ``[L/S, ...]`` (rows ``[j*L/S,
+    (j+1)*L/S)`` of the plain ``[L, ...]`` stack — a pure slice, no
+    transpose), the FIRST stage additionally owns the embedding tables
+    (``wte``/``wpe`` — and with them the weight-tied LM head's master
+    copy), and the LAST stage owns ``ln_f``. Inverse:
+    ``mpmd_merge_params``; ``unstack_pipeline_params`` accepts either
+    stacked layout so decode/export paths need no config surgery."""
+    if "blocks" not in params:
+        raise ValueError(
+            "mpmd_stage_params expects the PLAIN-layout params tree "
+            "(blocks leaves [L, ...]); restack pipeline-trained params "
+            "via unstack_pipeline_params first"
+        )
+    L, s = cfg.num_layers, num_stages
+    if s < 2:
+        raise ValueError(f"MPMD stage slicing needs >= 2 stages, got {s}")
+    if L % s:
+        raise ValueError(f"{L} layers not divisible by {s} stages")
+    lps = L // s
+    head_keys = {"ln_f"}
+    out = {}
+    for j in range(s):
+        tree = {
+            "blocks": jax.tree.map(
+                lambda l, _j=j: l[_j * lps : (_j + 1) * lps],
+                params["blocks"],
+            )
+        }
+        if j == 0:
+            # Everything outside the block stack that is not the final
+            # norm feeds the input side (wte/wpe today; future input-side
+            # params land here by default).
+            for k, v in params.items():
+                if k not in ("blocks", *head_keys):
+                    tree[k] = v
+        if j == s - 1:
+            for k in head_keys:
+                if k in params:
+                    tree[k] = params[k]
+        out[f"stage_{j}"] = tree
+    return out
+
+
+def mpmd_merge_params(cfg: GPTConfig, stage_params):
+    """Merge MPMD per-stage trees (``mpmd_stage_params`` layout) back to
+    the plain-stack params tree — blocks leaves concatenate along the
+    layer dim in stage order; wte/wpe come from stage 0, ln_f from the
+    last stage."""
+    stages = sorted(
+        (k for k in stage_params if k.startswith("stage_")),
+        key=lambda k: int(k.split("_", 1)[1]),
+    )
+    if len(stages) < 2 or stages != [f"stage_{j}" for j in range(len(stages))]:
+        raise ValueError(
+            f"not an MPMD stage-params tree (keys: {sorted(stage_params)})"
+        )
+    out = {}
+    for k, v in stage_params[stages[0]].items():
+        if k != "blocks":
+            out[k] = v
+    for k, v in stage_params[stages[-1]].items():
+        if k != "blocks":
+            out[k] = v
+    out["blocks"] = jax.tree.map(
+        lambda *ls: jnp.concatenate(ls, axis=0),
+        *[stage_params[k]["blocks"] for k in stages],
+    )
+    return out
+
+
+class GptStage(nn.Module):
+    """One MPMD pipeline stage as a standalone per-stage program body
+    (ISSUE 14): a contiguous run of ``num_layers`` Blocks, with the
+    embedding front (``wte``/``wpe`` + dropout) on the FIRST stage and
+    the final ``ln_f`` on the LAST. Param names match the monolithic
+    ``GPT`` exactly, so per-stage trees are pure slices of the plain
+    stack (``mpmd_stage_params``) and checkpoints restack losslessly.
+
+    The weight-tied LM head is deliberately NOT applied here: the last
+    stage returns ``ln_f``'d FEATURES, and the loss program receives the
+    first stage's embedding table as an explicit cross-stage input — the
+    tied-embedding transfer every MPMD system carries (its gradient
+    rides the reverse transfer back to stage 0's master copy).
+
+    ``param_hooks``/``tp_overlap`` take the same overlap-schedule hooks
+    as ``GPT`` (parallel/schedule.py ``hooked_model`` clones either
+    attribute): the fsdp block gathers and TP rings lower INSIDE the
+    stage program, where they compose exactly as in the monolithic scan
+    body — per-stage programs have no stage vmap for them to collide
+    with."""
+
+    config: GPTConfig
+    policy: Policy
+    num_layers: int
+    first: bool = False
+    last: bool = False
+    param_hooks: Any = None
+    tp_overlap: Any = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, train: bool = False):
+        cfg = self.config
+        dtype = self.policy.compute_dtype
+        if self.first:
+            # Same modules, names, initializers, and dtype flow as GPT's
+            # embedding front — stage 0's subtree IS the plain tree's.
+            wte = nn.Embed(
+                cfg.vocab_size,
+                cfg.hidden_dim,
+                dtype=dtype,
+                embedding_init=nn.initializers.normal(stddev=0.02),
+                name="wte",
+            )
+            wpe = self.param(
+                "wpe",
+                nn.initializers.normal(stddev=0.02),
+                (cfg.seq_len, cfg.hidden_dim),
+            )
+            t = x.shape[1]
+            x = wte(x) + wpe[:t].astype(dtype)
+            x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
+        stack_cls = _train_block_stack(
+            cfg, length=self.num_layers, hooks=self.param_hooks
+        )
+        blocks = stack_cls(
+            cfg, dtype, train, False, self.tp_overlap, 0, 0, 0,
+            name="blocks",
+        )
+        (x, _aux), _ = blocks((x, jnp.zeros((), jnp.float32)), None)
+        if self.last:
+            x = nn.LayerNorm(
+                dtype=jnp.float32, epsilon=cfg.layer_norm_epsilon,
+                name="ln_f",
+            )(x)
+        return x
+
+
 def unstack_pipeline_params(cfg: GPTConfig, params):
     """Restack pipeline-trained block params into the plain-stack layout.
 
@@ -81,6 +278,10 @@ def unstack_pipeline_params(cfg: GPTConfig, params):
     ``pipeline_stages=1`` model of the same config applies directly.
     """
     if "pipeline" not in params:
+        if "stage_0" in params:
+            # MPMD per-stage layout (ISSUE 14): merge, don't reshape —
+            # stage trees are plain-stack slices by construction.
+            return mpmd_merge_params(cfg, params)
         raise ValueError(
             "params carry no 'pipeline' subtree — already plain-stacked?"
         )
@@ -761,56 +962,22 @@ class GPT(nn.Module):
             )
             x, aux_loss = pipe(x, jnp.zeros((), jnp.float32))
         else:
-            block_cls = Block
-            hooks = self.param_hooks if not decode else None
-            if hooks is not None:
-                # Gather INSIDE the scan body (one layer's slice per
-                # iteration — the blockwise schedule) and inside the remat
-                # region below (so recompute re-gathers instead of saving
-                # full params). map_variables(init=False): param creation
-                # still sees the raw sharded tree, keeping init and
-                # checkpoint layouts identical to the unhooked model.
-                block_cls = nn.map_variables(
-                    block_cls,
-                    "params",
-                    trans_in_fn=hooks.block_hook,
-                    init=False,
+            if decode:
+                # Decode keeps its own plain scan: hooks/remat are
+                # training-path rewrites and never mix with the caches.
+                stack_cls = nn.scan(
+                    Block,
+                    length=cfg.num_layers,
+                    variable_axes={"params": 0, "cache": 0},
+                    split_rngs={"params": True, "dropout": True},
                 )
-            if (cfg.block_remat != "none" or hooks is not None) and not decode:
-                # Per-layer remat (config 3's activation checkpointing at
-                # the granularity that matters under nn.scan): checkpoint
-                # each scanned body so the backward re-derives one block's
-                # internals at a time instead of holding all L layers'.
-                # prevent_cse=False is the documented setting under scan —
-                # the scan boundary already stops the CSE that remat's
-                # default guards against, and leaving it True blocks XLA
-                # optimizations for nothing.
-                if hooks is not None:
-                    # Same three modes, with gathered params always
-                    # excluded from the saved set (GATHER_NAME tag).
-                    from frl_distributed_ml_scaffold_tpu.parallel.fsdp_overlap import (
-                        overlap_remat_policy,
-                    )
-
-                    policy = overlap_remat_policy(cfg.block_remat)
-                elif cfg.block_remat == "full":
-                    policy = None
-                elif cfg.block_remat == "save_attn":
-                    policy = jax.checkpoint_policies.save_only_these_names(
-                        "attn_out"
-                    )
-                else:
-                    raise KeyError(
-                        f"unknown model.block_remat={cfg.block_remat!r} "
-                        "(none | full | save_attn)"
-                    )
-                block_cls = nn.remat(block_cls, prevent_cse=False, policy=policy)
-            blocks = nn.scan(
-                block_cls,
-                length=cfg.num_layers,
-                variable_axes={"params": 0, "cache": 0},
-                split_rngs={"params": True, "dropout": True},
-            )(
+            else:
+                # Shared with the MPMD per-stage programs (GptStage):
+                # blockwise param-gather hook + per-block remat + scan.
+                stack_cls = _train_block_stack(
+                    cfg, length=cfg.num_layers, hooks=self.param_hooks
+                )
+            blocks = stack_cls(
                 cfg,
                 dtype,
                 train,

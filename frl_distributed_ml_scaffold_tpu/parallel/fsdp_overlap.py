@@ -274,11 +274,18 @@ def validate_block_schedule(cfg, *, prefetch: int) -> None:
             f"parallel.fsdp_overlap=true: model family {family!r} has no "
             f"blockwise apply hooks (supported: {SUPPORTED_FAMILIES})"
         )
-    if getattr(cfg.model, "pipeline_stages", 1) > 1:
+    if (
+        getattr(cfg.model, "pipeline_stages", 1) > 1
+        and getattr(cfg.model, "pipeline_impl", "spmd") != "mpmd"
+    ):
+        # The SPMD stage-vmap path owns its own block schedule; the MPMD
+        # backend (ISSUE 14) lowers the blockwise gathers INSIDE each
+        # per-stage program, where they compose as in the plain stack.
         raise ValueError(
             "parallel.fsdp_overlap composes with dp/fsdp/tp meshes but not "
-            "with pipeline parallelism (the pipeline path owns its own "
-            "block schedule); set model.pipeline_stages=1"
+            "with the SPMD pipeline backend (the stage-vmap path owns its "
+            "own block schedule); set model.pipeline_stages=1 or "
+            "model.pipeline_impl='mpmd'"
         )
     if prefetch < 0:
         raise ValueError(

@@ -1,0 +1,1240 @@
+"""MPMD pipeline parallelism: per-stage programs + host-side 1F1B driver
+(ISSUE 14; ROADMAP item 3 — the arXiv 2412.14374 formulation).
+
+The SPMD backend (parallel/pipeline.py) keeps the whole pipeline timeline
+inside ONE compiled program: stage weights carry a leading ``[S, ...]``
+vmap dim, a rolling ``jnp.roll`` buffer is the stage-to-stage send, and
+the GPipe scan holds all ``M`` microbatch activations live. This module
+is the other shape the paper argues for — **multiple programs, multiple
+data**:
+
+- **Per-stage programs.** Each pipeline stage is its own jitted program
+  (``models/gpt.py GptStage``) on its own ``pipe``-slice SUBMESH, with
+  stage-local params (plain ``[L/S, ...]`` block slices — no vmap dim)
+  and stage-local optimizer shards. FSDP/ZeRO/TP partitioning applies
+  per stage over the submesh's data/fsdp/model/seq axes, and the PR 13
+  overlap-schedule declarations lower per stage program (blockwise fsdp
+  gathers + collective-matmul rings inside a stage compose unchanged).
+- **Explicit transfers.** Inter-stage activation/gradient handoffs are
+  explicit ``jax.device_put`` calls between stage submeshes (the splice/
+  transfer discipline PR 12 established at the serving handoff, applied
+  to the training boundary). Nothing crosses stages inside a compiled
+  program — graft-lint pins every stage program free of ``pipe``-axis
+  collectives (``pipeline:stage_program``).
+- **1F1B schedule.** A host-side driver runs the classic
+  warmup/steady/cooldown order: stage ``j`` issues ``min(S-1-j, M)``
+  warmup forwards, then alternates one-forward-one-backward, then drains.
+  Steady state therefore holds only ``min(S, M)`` in-flight microbatch
+  boundary activations (stage 0's warmup depth) instead of GPipe's ``M``
+  — the analytic model below (``peak_live_activations``) is pinned
+  against the driver's measured counters in tests. The backward
+  recomputes each stage forward from its saved BOUNDARY input (the
+  memory profile 1F1B exists for); ``trainer.remat`` composes by
+  checkpointing the recompute's own residuals.
+
+Because per-stage programs never vmap over a stage dim, the
+``vmap(spmd_axis_name="pipe")`` x sequence-parallel shard_map lowering
+bug (BACKLOG R8-2) cannot occur: ring/ulysses attention open their
+shard_map regions directly inside a stage program. And because each
+stage is already a self-contained program with explicit boundary
+transfers, stages can move to separate slices (DCN between them) without
+changing shape — the training-side analogue of PR 12's worker split.
+
+Selection: ``model.pipeline_impl="mpmd"`` behind the existing knobs
+(``pipeline_stages``/``pipeline_microbatches`` keep their meaning;
+``effective_microbatches`` stays the single resolution rule). Grad
+accumulation folds into the same 1F1B run as extra microbatches — the
+two knobs both just microbatch the global batch here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from frl_distributed_ml_scaffold_tpu.dist.mesh import (
+    AXES,
+    BATCH_AXES,
+    MeshEnv,
+    mesh_context,
+)
+from frl_distributed_ml_scaffold_tpu.parallel.partition import (
+    opt_state_specs,
+    param_specs,
+    shardings_from_specs,
+)
+from frl_distributed_ml_scaffold_tpu.parallel.pipeline import (
+    circular_repeat,
+    effective_microbatches,
+)
+from frl_distributed_ml_scaffold_tpu.trainer.train_state import TrainState
+
+#: Schedules the analytic model knows. "gpipe" is the SPMD backend's
+#: all-forwards-then-all-backwards timeline; "1f1b" is this module's.
+SCHEDULES = ("gpipe", "1f1b")
+
+#: Donation seam for the stage update programs (params/opt-state/EMA are
+#: donated so stepping a stage never holds two copies of its state). The
+#: graft-lint mutation gate flips this to prove the donation audit bites.
+_DONATE_STAGE_STATE = True
+
+#: Donation seam for the per-microbatch transient buffers (saved boundary
+#: inputs, incoming cotangents, grad accumulators). Default OFF: with it
+#: on, this container's CPU jaxlib produced RARE nondeterministic grad
+#: corruption (~1e-3 param drift between identical runs) when two MPMD
+#: runners interleaved dispatch on overlapping submeshes — the same
+#: jaxlib that miscompiles vmap(spmd_axis_name) x shard_map (BACKLOG
+#: R8-2), and XLA reported most of these donations "not usable" anyway
+#: (grad layouts rarely alias through the vjp). Transient donation is an
+#: in-place-reuse optimization, NOT the 1F1B memory model: saved
+#: boundary activations are freed when their backward pops them either
+#: way. Revisit on TPU with an on-chip soak before flipping.
+_DONATE_TRANSIENTS = False
+
+
+def bubble_fraction(schedule: str, num_stages: int, num_microbatches: int) -> float:
+    """Idle fraction of the pipeline timeline: ``(S-1)/(M+S-1)``.
+
+    Fill/drain costs ``S-1`` microbatch-slots at both ends of the
+    timeline whichever way the middle is ordered, so GPipe and 1F1B share
+    the bubble FRACTION (at equal per-microbatch fwd+bwd cost); 1F1B's
+    win is peak activation MEMORY (``peak_live_activations`` — S vs M),
+    which is what unlocks large ``M`` and therefore small bubbles.
+    """
+    if schedule not in SCHEDULES:
+        raise KeyError(f"unknown pipeline schedule {schedule!r} ({SCHEDULES})")
+    s, m = int(num_stages), int(num_microbatches)
+    if s <= 1 or m < 1:
+        return 0.0
+    return (s - 1) / (m + s - 1)
+
+
+def peak_live_activations(
+    schedule: str, num_stages: int, num_microbatches: int
+) -> int:
+    """Max in-flight forward boundary activations any stage holds.
+
+    - ``gpipe``: every microbatch's activations stay live until the
+      backward sweep starts → ``M``.
+    - ``1f1b``: stage ``j`` warms up ``min(S-1-j, M)`` forwards and then
+      retires one activation per new forward → ``min(S-j, M)``; the max
+      (stage 0) is ``min(S, M)`` — ``< M`` whenever ``M > S``.
+    """
+    if schedule not in SCHEDULES:
+        raise KeyError(f"unknown pipeline schedule {schedule!r} ({SCHEDULES})")
+    s, m = int(num_stages), int(num_microbatches)
+    if s <= 1:
+        return 1
+    if schedule == "gpipe":
+        return max(m, 1)
+    return max(min(s, m), 1)
+
+
+def stage_peak_live(stage: int, num_stages: int, num_microbatches: int) -> int:
+    """1F1B per-stage peak in-flight activations: ``min(S - j, M)``."""
+    return max(min(num_stages - stage, num_microbatches), 1)
+
+
+def stage_submesh(env: MeshEnv, stage: int) -> MeshEnv:
+    """Stage ``stage``'s submesh: the full mesh's ``pipe`` axis sliced to
+    one coordinate (kept at size 1 so every PartitionSpec that names
+    ``pipe`` stays valid), all other axes intact — the device set one
+    per-stage program runs on."""
+    ax = AXES.index("pipe")
+    devs = np.take(env.mesh.devices, [stage], axis=ax)
+    return MeshEnv(
+        mesh=Mesh(devs, AXES),
+        config=dataclasses.replace(env.config, pipe=1),
+    )
+
+
+def _stage_forward(module, policy, params_c, x, rng, train: bool):
+    """Apply one stage program body on compute-cast params — the single
+    seam every fwd/bwd/loss program routes through (and the one the
+    graft-lint cross-stage-collective mutation gate patches)."""
+    del policy  # reserved for future per-stage policy overrides
+    rngs = {"dropout": rng} if train else None
+    return module.apply({"params": params_c}, x, train=train, rngs=rngs)
+
+
+class MpmdPipelineRunner:
+    """Builds the per-stage programs for one ExperimentConfig and drives
+    them: ``train_step``/``eval_step`` are drop-in replacements for the
+    Trainer's compiled steps (same ``(state, batch)`` contract), with the
+    TrainState's ``params``/``opt_state``/``ema_params`` holding
+    ``{"stage_j": ...}`` trees whose leaves live on stage ``j``'s
+    submesh."""
+
+    def __init__(self, cfg, env: MeshEnv, policy):
+        self.cfg = cfg
+        self.env = env
+        self.policy = policy
+        model_cfg = cfg.model
+        if getattr(model_cfg, "family", None) != "gpt":
+            raise ValueError(
+                "model.pipeline_impl='mpmd': per-stage programs are wired "
+                f"for the GPT stack (family {model_cfg.family!r}); use "
+                "pipeline_impl='spmd'"
+            )
+        if cfg.data.name not in ("lm", "lm_synthetic"):
+            raise ValueError(
+                "model.pipeline_impl='mpmd' implements the LM task "
+                f"(data.name {cfg.data.name!r})"
+            )
+        if model_cfg.moe.num_experts > 0:
+            raise ValueError(
+                "model.pipeline_impl='mpmd' does not support MoE blocks "
+                "(the router aux loss needs a cross-stage reduction the "
+                "explicit-transfer boundary does not carry yet); use "
+                "pipeline_impl='spmd'"
+            )
+        if circular_repeat(model_cfg) > 1:
+            raise ValueError(
+                "model.pipeline_impl='mpmd' runs the 1F1B schedule; the "
+                "circular (interleaved) schedule is an SPMD-backend "
+                "feature — set pipeline_circular_repeat=1 or "
+                "pipeline_impl='spmd'"
+            )
+        if cfg.trainer.offload_opt_state:
+            raise ValueError(
+                "model.pipeline_impl='mpmd' does not compose with "
+                "trainer.offload_opt_state (per-stage programs manage "
+                "their own state residency)"
+            )
+        s = int(model_cfg.pipeline_stages)
+        if s < 2:
+            raise ValueError("pipeline_impl='mpmd' needs pipeline_stages >= 2")
+        if env.axis_size("pipe") != s:
+            raise ValueError(
+                f"pipeline_impl='mpmd' maps one stage per pipe-mesh slice: "
+                f"mesh.pipe={env.axis_size('pipe')} != "
+                f"pipeline_stages={s}"
+            )
+        if model_cfg.num_layers % s:
+            raise ValueError(
+                f"{model_cfg.num_layers} layers not divisible by {s} stages"
+            )
+        self.num_stages = s
+        self.microbatches = effective_microbatches(model_cfg)
+        # Grad accumulation folds into the same 1F1B run: both knobs just
+        # split the global batch into per-microbatch programs here, and
+        # grads are averaged over all of them — numerically the SPMD
+        # path's mean-of-chunk-means at equal sizes.
+        self.total_micro = self.microbatches * cfg.trainer.grad_accum
+        b = cfg.data.global_batch_size
+        if b % self.total_micro:
+            raise ValueError(
+                f"data.global_batch_size={b} not divisible by "
+                f"pipeline_microbatches x grad_accum = {self.total_micro}"
+            )
+        self.micro_batch = b // self.total_micro
+        self.subenvs = [stage_submesh(env, j) for j in range(s)]
+        if self.micro_batch % self.subenvs[0].batch_axis_size:
+            raise ValueError(
+                f"microbatch size {self.micro_batch} not divisible by the "
+                f"stage submesh batch axes "
+                f"({self.subenvs[0].batch_axis_size})"
+            )
+        if model_cfg.lm_loss_chunk:
+            from frl_distributed_ml_scaffold_tpu.utils.logging import get_logger
+
+            get_logger().warning(
+                "pipeline_impl='mpmd' computes the LM head densely per "
+                "microbatch (model.lm_loss_chunk=%d ignored; microbatch "
+                "logits are already 1/M of the batch tensor)",
+                model_cfg.lm_loss_chunk,
+            )
+        # Optimizer WITHOUT the global-norm clip element: clipping needs
+        # the cross-stage norm, which the driver coordinates exactly —
+        # per-stage sq-norms summed on host, grads pre-scaled by
+        # clip/max(norm, clip) before a clip-less tx.update (optax's
+        # clip_by_global_norm is the first chain element, so pre-scaling
+        # is bit-for-bit its semantics).
+        from frl_distributed_ml_scaffold_tpu.trainer.optimizers import (
+            make_optimizer,
+        )
+
+        self.clip_norm = cfg.optimizer.grad_clip_norm
+        self.tx, self.lr_schedule = make_optimizer(
+            dataclasses.replace(cfg.optimizer, grad_clip_norm=None),
+            cfg.trainer,
+        )
+        self.has_ema = cfg.trainer.ema_decay > 0.0
+        self._layers_per_stage = model_cfg.num_layers // s
+
+        # Telemetry (attached per fit() by the Trainer).
+        self._telem = None
+        self._tracer = None
+        self._trace = None
+        self._watchdog = None
+        self._g_idle = None
+        self._g_bubble = None
+        self._c_transfer = None
+        #: Driver instrumentation from the last train_step: per-stage peak
+        #: in-flight boundary activations (the 1F1B memory pin reads
+        #: this) and explicit boundary-transfer bytes.
+        self.last_peak_live: list[int] = [0] * s
+        self.last_boundary_bytes: int = 0
+        self.last_stage_idle_s: list[float] = [0.0] * s
+        self._step_transfer_bytes = 0
+
+        self._build_modules()
+        self._build_specs()
+        self._build_programs()
+        self._logits_fn = None  # lazy (tests/export only)
+
+    # ------------------------------------------------------------- build
+
+    def _build_modules(self) -> None:
+        from frl_distributed_ml_scaffold_tpu.models.gpt import GptStage
+
+        s = self.num_stages
+        self._modules = [
+            GptStage(
+                self.cfg.model,
+                self.policy,
+                num_layers=self._layers_per_stage,
+                first=(j == 0),
+                last=(j == s - 1),
+            )
+            for j in range(s)
+        ]
+
+    def _stage_example(self, j: int, batch: int):
+        cfg = self.cfg.model
+        t = cfg.seq_len
+        if j == 0:
+            return jnp.zeros((batch, t), jnp.int32)
+        return jnp.zeros(
+            (batch, t, cfg.hidden_dim), self.policy.compute_dtype
+        )
+
+    def _build_specs(self) -> None:
+        """Per-stage state shapes/specs/shardings (the Trainer re-exports
+        them so checkpointing sees one TrainState-shaped tree whose
+        leaves carry per-submesh NamedShardings)."""
+        from frl_distributed_ml_scaffold_tpu.models.gpt import gpt_tp_rules
+
+        cfg = self.cfg
+        seed_key = jax.random.key(cfg.trainer.seed)
+        self._param_shapes = []
+        self._param_specs = []
+        self._param_shardings = []
+        self._opt_shapes = []
+        self._opt_specs = []
+        self._opt_shardings = []
+        self._grad_shardings = []
+        for j, (sub, module) in enumerate(zip(self.subenvs, self._modules)):
+            rng = jax.random.fold_in(seed_key, j)
+            ex = self._stage_example(j, self.micro_batch)
+
+            def init_fn(r, _m=module, _x=ex):
+                return _m.init({"params": r}, _x, train=False)["params"]
+
+            with mesh_context(sub):
+                shapes = jax.eval_shape(init_fn, rng)
+                opt_shapes = jax.eval_shape(self.tx.init, shapes)
+            rules = (
+                gpt_tp_rules() if sub.axis_size("model") > 1
+                or sub.axis_size("expert") > 1 else None
+            )
+            p_specs = param_specs(shapes, cfg.parallel, sub.mesh, rules)
+            o_specs = opt_state_specs(
+                opt_shapes, shapes, p_specs, cfg.parallel, sub.mesh
+            )
+            self._opt_specs.append(o_specs)
+            self._param_shapes.append(shapes)
+            self._param_specs.append(p_specs)
+            self._param_shardings.append(
+                shardings_from_specs(p_specs, sub.mesh)
+            )
+            self._opt_shapes.append(opt_shapes)
+            self._opt_shardings.append(
+                shardings_from_specs(o_specs, sub.mesh)
+            )
+            # Grad accumulators ride the params' (possibly fsdp-sharded)
+            # layout — microbatch grads accumulate as SHARDS, the SPMD
+            # path's grad_shardings discipline.
+            self._grad_shardings.append(
+                shardings_from_specs(p_specs, sub.mesh)
+            )
+        s = self.num_stages
+        self.state_shapes = TrainState(
+            step=jax.ShapeDtypeStruct((), jnp.int32),
+            params={f"stage_{j}": self._param_shapes[j] for j in range(s)},
+            opt_state={f"stage_{j}": self._opt_shapes[j] for j in range(s)},
+            extras={},
+            ema_params=(
+                {f"stage_{j}": self._param_shapes[j] for j in range(s)}
+                if self.has_ema else None
+            ),
+        )
+        self.state_specs = TrainState(
+            step=P(),
+            params={f"stage_{j}": self._param_specs[j] for j in range(s)},
+            opt_state={f"stage_{j}": self._opt_specs[j] for j in range(s)},
+            extras={},
+            ema_params=(
+                {f"stage_{j}": self._param_specs[j] for j in range(s)}
+                if self.has_ema else None
+            ),
+        )
+        self.state_shardings = TrainState(
+            step=NamedSharding(self.subenvs[0].mesh, P()),
+            params={f"stage_{j}": self._param_shardings[j] for j in range(s)},
+            opt_state={f"stage_{j}": self._opt_shardings[j] for j in range(s)},
+            extras={},
+            ema_params=(
+                {f"stage_{j}": self._param_shardings[j] for j in range(s)}
+                if self.has_ema else None
+            ),
+        )
+
+        # The attached overlap schedule lowers per stage program: the
+        # hook mechanisms need the stage's own param specs + submesh.
+        from frl_distributed_ml_scaffold_tpu.parallel.schedule import (
+            hooked_model,
+            schedule_from_config,
+        )
+
+        sched = schedule_from_config(cfg)
+        self.overlap_schedule = sched
+        if sched is not None:
+            self._loss_modules = [
+                hooked_model(
+                    sched, m, cfg, self.subenvs[j], self._param_specs[j]
+                )
+                for j, m in enumerate(self._modules)
+            ]
+        else:
+            self._loss_modules = list(self._modules)
+
+        # Boundary layouts. Activations ENTERING stage j live on stage
+        # j's submesh: batch-sharded over (data, fsdp); the sequence dim
+        # rides the seq axis when populated, or the model axis when the
+        # TP rings keep the residual stream sequence-sharded
+        # (TpHooks.stream_spec) — the transfer then moves the already-
+        # sharded stream, never a gathered copy.
+        def boundary_spec(j):
+            hooks = getattr(self._loss_modules[j], "tp_overlap", None)
+            if hooks is not None:
+                return hooks.stream_spec()
+            sub = self.subenvs[j]
+            if (
+                sub.axis_size("seq") > 1
+                and cfg.model.seq_len % sub.axis_size("seq") == 0
+            ):
+                return P(BATCH_AXES, "seq", None)
+            return P(BATCH_AXES, None, None)
+
+        self._bound_shardings = [
+            NamedSharding(self.subenvs[j].mesh, boundary_spec(j))
+            for j in range(s)
+        ]
+        self._tok_sharding0 = NamedSharding(
+            self.subenvs[0].mesh, P(BATCH_AXES, None)
+        )
+        self._tgt_sharding_last = NamedSharding(
+            self.subenvs[s - 1].mesh, P(BATCH_AXES, None)
+        )
+        # The tied embedding's cross-stage mirrors: the last stage reads
+        # the compute-cast table for the LM head; its gradient rides the
+        # reverse transfer back into stage 0's master copy.
+        emb_spec = self._param_specs[0]["wte"]["embedding"]
+        self._emb_sharding_last = NamedSharding(
+            self.subenvs[s - 1].mesh, emb_spec
+        )
+        self._emb_grad_sharding0 = NamedSharding(
+            self.subenvs[0].mesh, emb_spec
+        )
+        self._scalar_shardings = [
+            NamedSharding(self.subenvs[j].mesh, P()) for j in range(s)
+        ]
+
+    def _scoped(self, j: int, fn):
+        """Trace-time mesh context for stage ``j``'s programs (the
+        Trainer's ``_mesh_scoped`` discipline, per submesh)."""
+
+        def wrapped(*args, **kwargs):
+            with mesh_context(self.subenvs[j]):
+                return fn(*args, **kwargs)
+
+        return wrapped
+
+    def _maybe_remat(self, f):
+        """``trainer.remat`` composes with the stage-boundary recompute:
+        the bwd programs re-run the stage forward from its saved input
+        either way (that IS the 1F1B memory profile); remat modes
+        additionally checkpoint the recompute's own residuals."""
+        remat = self.cfg.trainer.remat
+        if remat == "none":
+            return f
+        if remat == "full":
+            return jax.checkpoint(f)
+        if remat == "dots":
+            return jax.checkpoint(
+                f, policy=jax.checkpoint_policies.checkpoint_dots
+            )
+        raise KeyError(f"unknown remat mode {remat!r}")
+
+    def _build_programs(self) -> None:
+        cfg, policy = self.cfg, self.policy
+        s = self.num_stages
+        dtype = policy.compute_dtype
+        rdtype = policy.reduce_dtype
+        ema_d = cfg.trainer.ema_decay
+        inv = 1.0 / self.total_micro
+
+        self._fwd_fn, self._fwd = [], []
+        self._bwd_fn, self._bwd = [], []
+        self._fin_fn, self._fin = [], []
+        self._upd_fn, self._upd = [], []
+        self._zero_grads = []
+        self._eval_fwd = []
+
+        for j in range(s):
+            module = self._loss_modules[j]
+            g_sh = self._grad_shardings[j]
+
+            def fwd(params, x, rng, _m=module):
+                pc = policy.cast_to_compute(params)
+                return _stage_forward(_m, policy, pc, x, rng, True)
+
+            self._fwd_fn.append(fwd)
+            self._fwd.append(self._scoped(j, jax.jit(fwd)))
+
+            if j < s - 1:
+
+                def bwd(params, x, g_out, rng, g_acc, _m=module,
+                        _j=j, _gsh=g_sh):
+                    pc = policy.cast_to_compute(params)
+
+                    def f(p, xx):
+                        return _stage_forward(_m, policy, p, xx, rng, True)
+
+                    f = self._maybe_remat(f)
+                    if _j == 0:
+                        # Tokens are integral — no input cotangent.
+                        _, vjp = jax.vjp(lambda p: f(p, x), pc)
+                        (gp,) = vjp(g_out)
+                        gx = None
+                    else:
+                        _, vjp = jax.vjp(f, pc, x)
+                        gp, gx = vjp(g_out)
+                    g_acc = jax.tree.map(
+                        lambda a, g: a + g.astype(rdtype), g_acc, gp
+                    )
+                    g_acc = jax.lax.with_sharding_constraint(g_acc, _gsh)
+                    return g_acc if _j == 0 else (g_acc, gx)
+
+                donate = (2, 4) if j == 0 else (1, 2, 4)
+                if not _DONATE_TRANSIENTS:
+                    donate = ()
+                self._bwd_fn.append(bwd)
+                self._bwd.append(
+                    self._scoped(j, jax.jit(bwd, donate_argnums=donate))
+                )
+            else:
+                # Last stage: fused fwd+bwd per microbatch — the LM head
+                # (weight-tied: the transferred embedding mirror) + CE,
+                # value_and_grad over (params, embedding, input) in one
+                # program; its input cotangent starts the reverse
+                # pipeline.
+                def last(params, emb, x, targets, rng, g_acc, g_emb_acc,
+                         _m=module, _gsh=g_sh):
+                    pc = policy.cast_to_compute(params)
+
+                    def f(p, e, xx):
+                        feats = _stage_forward(_m, policy, p, xx, rng, True)
+                        # Exactly wte.attend's math (models/gpt.py):
+                        # compute-dtype matmul, fp32 softmax-CE after.
+                        logits = (feats.astype(dtype) @ e.T).astype(
+                            jnp.float32
+                        )
+                        return optax.softmax_cross_entropy_with_integer_labels(
+                            logits, targets
+                        ).mean()
+
+                    f = self._maybe_remat(f)
+                    ce, (gp, ge, gx) = jax.value_and_grad(
+                        f, argnums=(0, 1, 2)
+                    )(pc, emb, x)
+                    g_acc = jax.tree.map(
+                        lambda a, g: a + g.astype(rdtype), g_acc, gp
+                    )
+                    g_acc = jax.lax.with_sharding_constraint(g_acc, _gsh)
+                    g_emb_acc = g_emb_acc + ge.astype(rdtype)
+                    metrics = {"ce_loss": ce, "perplexity": jnp.exp(ce)}
+                    return ce, metrics, g_acc, g_emb_acc, gx
+
+                self._last_fn = last
+                self._last = self._scoped(
+                    j,
+                    jax.jit(
+                        last,
+                        donate_argnums=(
+                            (2, 5, 6) if _DONATE_TRANSIENTS else ()
+                        ),
+                    ),
+                )
+
+            # Grad finalize: average over all microbatches, cast to the
+            # param dtype (the SPMD step's cast_to_param point), and emit
+            # the stage's squared grad norm for the host-coordinated
+            # global clip + grad_norm metric. Stage 0 folds the tied
+            # embedding's transferred head gradient in first.
+            if j == 0:
+
+                def fin(g_acc, g_emb, _gsh=g_sh):
+                    wte = dict(g_acc["wte"])
+                    wte["embedding"] = wte["embedding"] + g_emb
+                    g_acc = {**g_acc, "wte": wte}
+                    g = jax.tree.map(lambda t: t * inv, g_acc)
+                    g = policy.cast_to_param(g)
+                    g = jax.lax.with_sharding_constraint(g, _gsh)
+                    sq = sum(
+                        jnp.sum(jnp.square(l.astype(jnp.float32)))
+                        for l in jax.tree.leaves(g)
+                    )
+                    return g, sq
+
+                fin_donate = (0, 1)
+            else:
+
+                def fin(g_acc, _gsh=g_sh):
+                    g = jax.tree.map(lambda t: t * inv, g_acc)
+                    g = policy.cast_to_param(g)
+                    g = jax.lax.with_sharding_constraint(g, _gsh)
+                    sq = sum(
+                        jnp.sum(jnp.square(l.astype(jnp.float32)))
+                        for l in jax.tree.leaves(g)
+                    )
+                    return g, sq
+
+                fin_donate = (0,)
+            if not _DONATE_TRANSIENTS:
+                fin_donate = ()
+            self._fin_fn.append(fin)
+            self._fin.append(
+                self._scoped(j, jax.jit(fin, donate_argnums=fin_donate))
+            )
+
+            # Stage update: clip factor in, new stage state out, old
+            # state donated (the per-stage face of the train step's
+            # donate_argnums=(0,) — audited by graft-lint's
+            # pipeline:stage_program family).
+            if self.has_ema:
+
+                def upd(params, opt, ema, g, factor):
+                    g = jax.tree.map(lambda t: t * factor, g)
+                    updates, new_opt = self.tx.update(g, opt, params)
+                    new_params = optax.apply_updates(params, updates)
+                    new_ema = jax.tree.map(
+                        lambda e, p: e * ema_d
+                        + p.astype(e.dtype) * (1.0 - ema_d),
+                        ema,
+                        new_params,
+                    )
+                    return new_params, new_opt, new_ema
+
+                upd_out = (
+                    self._param_shardings[j],
+                    self._opt_shardings[j],
+                    self._param_shardings[j],
+                )
+                upd_donate = (0, 1, 2, 3)
+            else:
+
+                def upd(params, opt, g, factor):
+                    g = jax.tree.map(lambda t: t * factor, g)
+                    updates, new_opt = self.tx.update(g, opt, params)
+                    new_params = optax.apply_updates(params, updates)
+                    return new_params, new_opt
+
+                upd_out = (self._param_shardings[j], self._opt_shardings[j])
+                upd_donate = (0, 1, 2)
+            self._upd_fn.append(upd)
+            self._upd.append(
+                self._scoped(
+                    j,
+                    jax.jit(
+                        upd,
+                        donate_argnums=(
+                            upd_donate if _DONATE_STAGE_STATE else ()
+                        ),
+                        out_shardings=upd_out,
+                    ),
+                )
+            )
+
+            shapes = self._param_shapes[j]
+
+            def zeros(_shapes=shapes):
+                return jax.tree.map(
+                    lambda l: jnp.zeros(l.shape, rdtype), _shapes
+                )
+
+            self._zero_grads.append(
+                self._scoped(
+                    j, jax.jit(zeros, out_shardings=self._grad_shardings[j])
+                )
+            )
+
+            def efwd(params, x, _m=module):
+                pc = policy.cast_to_compute(params)
+                return _stage_forward(_m, policy, pc, x, None, False)
+
+            self._eval_fwd.append(self._scoped(j, jax.jit(efwd)))
+
+        emb_shape = self._param_shapes[0]["wte"]["embedding"]
+
+        def zero_emb():
+            return jnp.zeros(emb_shape.shape, rdtype)
+
+        self._zero_emb = self._scoped(
+            s - 1,
+            jax.jit(
+                zero_emb,
+                out_shardings=NamedSharding(
+                    self.subenvs[s - 1].mesh,
+                    self._param_specs[0]["wte"]["embedding"],
+                ),
+            ),
+        )
+
+        # Tiny stage-0 helper for the cross-stage grad norm: the DRIVER
+        # is host-side code (the hygiene pass must not read it as a
+        # traced fn), so even the final sqrt runs as a compiled program.
+        self._sqrt0 = self._scoped(0, jax.jit(jnp.sqrt))
+
+        def eval_loss(params, emb, x, targets, _m=self._loss_modules[-1]):
+            pc = policy.cast_to_compute(params)
+            feats = _stage_forward(_m, policy, pc, x, None, False)
+            logits = (feats.astype(dtype) @ emb.T).astype(jnp.float32)
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                logits, targets
+            ).mean()
+            return ce, {"ce_loss": ce, "perplexity": jnp.exp(ce)}
+
+        self._eval_loss = self._scoped(s - 1, jax.jit(eval_loss))
+
+    # ----------------------------------------------------------- init
+
+    def init_state(self) -> TrainState:
+        """Per-stage sharded init (each stage's params materialize
+        directly on its submesh) assembled into ONE TrainState."""
+        cfg = self.cfg
+        seed_key = jax.random.key(cfg.trainer.seed)
+        params = {}
+        opt = {}
+        for j, (sub, module) in enumerate(zip(self.subenvs, self._modules)):
+            rng = jax.random.fold_in(seed_key, j)
+            ex = self._stage_example(j, self.micro_batch)
+
+            def init_fn(r, _m=module, _x=ex):
+                return _m.init({"params": r}, _x, train=False)["params"]
+
+            with mesh_context(sub):
+                params[f"stage_{j}"] = jax.jit(
+                    init_fn, out_shardings=self._param_shardings[j]
+                )(rng)
+                opt[f"stage_{j}"] = jax.jit(
+                    self.tx.init, out_shardings=self._opt_shardings[j]
+                )(params[f"stage_{j}"])
+        ema = (
+            jax.tree.map(jnp.copy, params) if self.has_ema else None
+        )
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=opt,
+            extras={},
+            ema_params=ema,
+        )
+
+    def place_plain_params(self, plain_host_params) -> dict:
+        """Slice a PLAIN-layout (host) params tree into the per-stage
+        layout and place each stage's slice on its submesh — the
+        mpmd face of ``trainer.init_params_path`` and of the parity
+        tests' shared-init discipline."""
+        from frl_distributed_ml_scaffold_tpu.models.gpt import (
+            mpmd_stage_params,
+        )
+
+        # Defensive copy BEFORE device_put: on the CPU backend,
+        # jax.device_get returns numpy VIEWS of the device buffers and
+        # device_put can zero-copy alias host memory — so params "placed"
+        # from another trainer's device_get would silently change when
+        # that trainer's donated step reuses the aliased buffers
+        # (observed: a DP reference step corrupting the staged params it
+        # was being compared against). A host-side copy breaks the chain.
+        plain_host_params = jax.tree.map(
+            lambda l: np.array(l, copy=True), plain_host_params
+        )
+        staged = mpmd_stage_params(
+            self.cfg.model, plain_host_params, self.num_stages
+        )
+        return {
+            f"stage_{j}": jax.device_put(
+                staged[f"stage_{j}"], self._param_shardings[j]
+            )
+            for j in range(self.num_stages)
+        }
+
+    # ------------------------------------------------------- telemetry
+
+    def attach_telemetry(
+        self, *, registry=None, tracer=None, trace=None, watchdog=None
+    ) -> None:
+        """Wire the fit() loop's telemetry into the 1F1B driver: per-stage
+        idle gauges + the analytic bubble gauge, boundary-transfer
+        counter, stage-lane spans, and watchdog beats from inside the
+        driver loop (a wedged inter-stage transfer then fires the PR 7
+        stall dump instead of hanging silently)."""
+        self._tracer = tracer
+        self._trace = trace
+        self._watchdog = watchdog
+        self._telem = registry
+        if registry is not None:
+            self._g_idle = [
+                registry.gauge(
+                    f"pipeline_stage{j}_idle_s",
+                    help="host-observed dispatch shadow of stage j per "
+                    "step (fill/drain + starvation)",
+                )
+                for j in range(self.num_stages)
+            ]
+            self._g_bubble = registry.gauge(
+                "pipeline_bubble_fraction",
+                help="analytic (S-1)/(M+S-1) of the running 1F1B schedule",
+            )
+            self._c_transfer = registry.counter(
+                "pipeline_boundary_transfer_bytes_total",
+                help="explicit inter-stage activation/gradient bytes "
+                "moved by the driver",
+            )
+
+    def _span(self, name: str, **fields):
+        if self._tracer is not None and getattr(self._tracer, "enabled", False):
+            return self._tracer.span(
+                name, trace=self._trace, cat="pipeline", **fields
+            )
+        import contextlib
+
+        return contextlib.nullcontext()
+
+    # ---------------------------------------------------------- driver
+
+    def _transfer(self, arr, sharding):
+        out = jax.device_put(arr, sharding)
+        self._step_transfer_bytes += int(arr.size) * arr.dtype.itemsize
+        return out
+
+    def _stage_ops(self, j: int):
+        """Stage ``j``'s 1F1B op string: warmup forwards, steady 1F1B
+        pairs, cooldown backwards. The last stage runs fused
+        forward+backward microsteps ('X')."""
+        m = self.total_micro
+        if j == self.num_stages - 1:
+            return ["X"] * m
+        w = min(self.num_stages - 1 - j, m)
+        return ["F"] * w + ["F", "B"] * (m - w) + ["B"] * w
+
+    def train_step(self, state: TrainState, batch) -> tuple[TrainState, dict]:
+        """One optimizer step: a full 1F1B pass over
+        ``microbatches x grad_accum`` microbatches, explicit boundary
+        transfers between stage submeshes, then per-stage updates under
+        one host-coordinated global grad norm."""
+        cfg, policy = self.cfg, self.policy
+        s, mt, mb = self.num_stages, self.total_micro, self.micro_batch
+        t_start = time.perf_counter()
+        self._step_transfer_bytes = 0
+        tokens = batch["tokens"]
+        step_num = int(jax.device_get(state.step))
+        step_key = jax.random.fold_in(
+            jax.random.key(cfg.trainer.seed), state.step
+        )
+        stage_keys = [
+            jax.device_put(step_key, self._scalar_shardings[j])
+            for j in range(s)
+        ]
+        params = state.params
+        emb = params["stage_0"]["wte"]["embedding"]
+        emb_last = self._transfer(
+            emb.astype(policy.compute_dtype), self._emb_sharding_last
+        )
+
+        def rng_for(j, m):
+            return jax.random.fold_in(
+                jax.random.fold_in(stage_keys[j], m), j
+            )
+
+        def ingest_tokens(m):
+            sl = tokens[m * mb : (m + 1) * mb]
+            return self._transfer(sl[:, :-1], self._tok_sharding0)
+
+        def ingest_targets(m):
+            sl = tokens[m * mb : (m + 1) * mb]
+            return self._transfer(sl[:, 1:], self._tgt_sharding_last)
+
+        g_acc = [self._zero_grads[j]() for j in range(s)]
+        g_emb_acc = self._zero_emb()
+        ops = [self._stage_ops(j) for j in range(s)]
+        pc = [0] * s
+        f_cnt = [0] * s
+        b_cnt = [0] * s
+        saved: list[dict] = [{} for _ in range(s)]
+        ready_acts: list[dict] = [{} for _ in range(s)]
+        ready_grads: list[dict] = [{} for _ in range(s)]
+        peak_live = [0] * s
+        first_t: list[float | None] = [None] * s
+        last_t: list[float | None] = [None] * s
+        losses = []
+        metrics_sum = None
+
+        def mark(j):
+            now = time.perf_counter()
+            if first_t[j] is None:
+                first_t[j] = now
+            last_t[j] = now
+
+        while any(pc[j] < len(ops[j]) for j in range(s)):
+            progressed = False
+            for j in range(s):
+                if pc[j] >= len(ops[j]):
+                    continue
+                op = ops[j][pc[j]]
+                if op == "F":
+                    m = f_cnt[j]
+                    if j == 0:
+                        x = ingest_tokens(m)
+                    elif m in ready_acts[j]:
+                        x = ready_acts[j].pop(m)
+                    else:
+                        continue
+                    with self._span(f"stage{j}_fwd", step=step_num,
+                                    microbatch=m):
+                        y = self._fwd[j](
+                            params[f"stage_{j}"], x, rng_for(j, m)
+                        )
+                    mark(j)
+                    saved[j][m] = x
+                    peak_live[j] = max(peak_live[j], len(saved[j]))
+                    ready_acts[j + 1][m] = self._transfer(
+                        y, self._bound_shardings[j + 1]
+                    )
+                    f_cnt[j] += 1
+                elif op == "X":  # last stage: fused fwd+bwd
+                    m = f_cnt[j]
+                    if m not in ready_acts[j]:
+                        continue
+                    x = ready_acts[j].pop(m)
+                    tgt = ingest_targets(m)
+                    with self._span(f"stage{j}_fwd_bwd", step=step_num,
+                                    microbatch=m):
+                        ce, mtr, g_acc[j], g_emb_acc, gx = self._last(
+                            params[f"stage_{j}"], emb_last, x, tgt,
+                            rng_for(j, m), g_acc[j], g_emb_acc,
+                        )
+                    mark(j)
+                    losses.append(ce)
+                    metrics_sum = (
+                        mtr if metrics_sum is None
+                        else jax.tree.map(
+                            lambda a, b: a + b, metrics_sum, mtr
+                        )
+                    )
+                    if s > 1:
+                        ready_grads[j - 1][m] = self._transfer(
+                            gx, self._bound_shardings[j - 1]
+                        )
+                    f_cnt[j] += 1
+                    b_cnt[j] += 1
+                else:  # "B"
+                    m = b_cnt[j]
+                    if m not in ready_grads[j]:
+                        continue
+                    g = ready_grads[j].pop(m)
+                    x = saved[j].pop(m)
+                    with self._span(f"stage{j}_bwd", step=step_num,
+                                    microbatch=m):
+                        if j == 0:
+                            g_acc[0] = self._bwd[0](
+                                params["stage_0"], x, g, rng_for(0, m),
+                                g_acc[0],
+                            )
+                        else:
+                            g_acc[j], gx = self._bwd[j](
+                                params[f"stage_{j}"], x, g, rng_for(j, m),
+                                g_acc[j],
+                            )
+                            ready_grads[j - 1][m] = self._transfer(
+                                gx, self._bound_shardings[j - 1]
+                            )
+                    mark(j)
+                    b_cnt[j] += 1
+                pc[j] += 1
+                progressed = True
+                if self._watchdog is not None:
+                    # Beats from INSIDE the driver loop: a wedged
+                    # transfer/dispatch silences them and fires the dump.
+                    self._watchdog.beat()
+            if not progressed:
+                raise RuntimeError(
+                    "1F1B schedule wedged: no stage op is ready "
+                    f"(pc={pc}, fwd={f_cnt}, bwd={b_cnt}) — schedule "
+                    "bookkeeping bug, not a device stall"
+                )
+
+        # Finalize: average + cast per stage; tied-embedding head grad
+        # transfers back to stage 0; ONE global norm across stages.
+        g_emb0 = self._transfer(g_emb_acc, self._emb_grad_sharding0)
+        grads, sqs = [], []
+        for j in range(s):
+            args = (g_acc[j], g_emb0) if j == 0 else (g_acc[j],)
+            g, sq = self._fin[j](*args)
+            grads.append(g)
+            sqs.append(sq)
+        sq_total = sum(
+            jax.device_put(sq, self._scalar_shardings[0]) for sq in sqs
+        )
+        gnorm = self._sqrt0(sq_total)
+        if self.clip_norm is not None:
+            # Host-coordinated exact clip_by_global_norm: factor applied
+            # to the averaged param-dtype grads, clip element stripped
+            # from the per-stage chain (see __init__).
+            gn = float(jax.device_get(gnorm))
+            factor = 1.0 if gn < self.clip_norm else self.clip_norm / gn
+        else:
+            factor = 1.0
+
+        new_params, new_opt, new_ema = {}, {}, {}
+        for j in range(s):
+            key = f"stage_{j}"
+            with self._span(f"stage{j}_update", step=step_num):
+                if self.has_ema:
+                    p, o, e = self._upd[j](
+                        params[key], state.opt_state[key],
+                        state.ema_params[key], grads[j], factor,
+                    )
+                    new_ema[key] = e
+                else:
+                    p, o = self._upd[j](
+                        params[key], state.opt_state[key], grads[j], factor
+                    )
+                new_params[key] = p
+                new_opt[key] = o
+            if self._watchdog is not None:
+                self._watchdog.beat()
+
+        t_end = time.perf_counter()
+        self.last_peak_live = peak_live
+        self.last_boundary_bytes = self._step_transfer_bytes
+        self.last_stage_idle_s = [
+            (first_t[j] - t_start if first_t[j] is not None else 0.0)
+            + (t_end - last_t[j] if last_t[j] is not None else 0.0)
+            for j in range(s)
+        ]
+        if self._telem is not None:
+            for j in range(s):
+                self._g_idle[j].set(self.last_stage_idle_s[j])
+            self._g_bubble.set(bubble_fraction("1f1b", s, mt))
+            self._c_transfer.inc(self._step_transfer_bytes)
+
+        inv_m = 1.0 / len(losses)
+        metrics = {
+            k: v * inv_m for k, v in (metrics_sum or {}).items()
+        }
+        metrics["loss"] = sum(losses) * inv_m
+        metrics["grad_norm"] = gnorm
+        new_state = TrainState(
+            step=state.step + 1,
+            params=new_params,
+            opt_state=new_opt,
+            extras={},
+            ema_params=new_ema if self.has_ema else None,
+        )
+        return new_state, metrics
+
+    # ------------------------------------------------------------ eval
+
+    def _forward_features(self, params, inputs):
+        """Full-batch forward through stages ``0..S-2`` (eval/export):
+        returns the LAST stage's boundary input on the last submesh (the
+        last stage itself runs inside the loss/logits program)."""
+        x = self._transfer(inputs, self._tok_sharding0)
+        for j in range(self.num_stages - 1):
+            y = self._eval_fwd[j](params[f"stage_{j}"], x)
+            x = self._transfer(y, self._bound_shardings[j + 1])
+        return x
+
+    def eval_step(self, state: TrainState, batch) -> dict:
+        """Forward-only metrics step (the make_eval_step contract)."""
+        self._step_transfer_bytes = 0
+        tokens = batch["tokens"]
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        params = state.params
+        x = self._forward_features(params, inputs)
+        emb = params["stage_0"]["wte"]["embedding"]
+        emb_last = self._transfer(
+            emb.astype(self.policy.compute_dtype), self._emb_sharding_last
+        )
+        tgt = self._transfer(targets, self._tgt_sharding_last)
+        ce, metrics = self._eval_loss(
+            params[f"stage_{self.num_stages - 1}"], emb_last, x, tgt
+        )
+        out = dict(metrics)
+        out["loss"] = ce
+        return out
+
+    def apply_logits(self, params, inputs):
+        """Full-batch logits (tests/parity rigs): the per-stage forward
+        chain + the weight-tied head, numerically the plain GPT apply."""
+        s = self.num_stages
+        if self._logits_fn is None:
+            dtype = self.policy.compute_dtype
+            policy = self.policy
+            module = self._loss_modules[-1]
+
+            def logits_fn(p_last, emb, x):
+                pc = policy.cast_to_compute(p_last)
+                feats = _stage_forward(module, policy, pc, x, None, False)
+                return feats.astype(dtype) @ emb.T
+
+            self._logits_fn = self._scoped(s - 1, jax.jit(logits_fn))
+        x = self._forward_features(params, inputs)
+        emb = params["stage_0"]["wte"]["embedding"]
+        emb_last = self._transfer(
+            emb.astype(self.policy.compute_dtype), self._emb_sharding_last
+        )
+        return self._logits_fn(params[f"stage_{s - 1}"], emb_last, x)
+
+    # ------------------------------------------------------- analysis
+
+    def step_cost_analysis(self) -> dict | None:
+        """Analytic step FLOPs for MFU logging: per-microbatch fwd+bwd
+        jaxpr FLOPs summed over stages x microbatches, plus the update
+        programs (the jaxpr counter the SPMD path falls back to)."""
+        try:
+            from frl_distributed_ml_scaffold_tpu.utils.flops import (
+                jaxpr_flops,
+            )
+
+            total = 0.0
+            for art in self.lint_artifacts():
+                total += jaxpr_flops(art["fwd_bwd_jaxpr"]) * self.total_micro
+            return {"flops": float(total), "flops_source": "jaxpr-mpmd"}
+        except Exception:
+            return None
+
+    def lint_artifacts(self) -> list[dict]:
+        """ABSTRACT per-stage programs for graft-lint and the perf ledger
+        (nothing runs): per stage, the microbatch fwd jaxpr, the fused
+        fwd+bwd jaxpr (last stage: the loss/grad program), and the
+        LOWERED update program for the donation audit — the artifacts the
+        ``pipeline:stage_program`` family pins free of cross-stage
+        collectives and donation regressions."""
+        out = []
+        s = self.num_stages
+        key_aval = jax.eval_shape(lambda: jax.random.key(0))
+        for j in range(s):
+            sub = self.subenvs[j]
+            shapes = self._param_shapes[j]
+            x_aval = jax.eval_shape(
+                lambda _j=j: self._stage_example(_j, self.micro_batch)
+            )
+            g_aval = jax.eval_shape(
+                lambda: jax.tree.map(
+                    lambda l: jnp.zeros(l.shape, self.policy.reduce_dtype),
+                    shapes,
+                )
+            )
+            with mesh_context(sub):
+                fwd_jaxpr = jax.make_jaxpr(self._fwd_fn[j])(
+                    shapes, x_aval, key_aval
+                )
+                if j < s - 1:
+                    y_aval = jax.eval_shape(
+                        self._fwd_fn[j], shapes, x_aval, key_aval
+                    )
+                    if j == 0:
+                        fb = jax.make_jaxpr(
+                            lambda p, x, g, r, ga: self._bwd_fn[j](
+                                p, x, g, r, ga
+                            )
+                        )(shapes, x_aval, y_aval, key_aval, g_aval)
+                    else:
+                        fb = jax.make_jaxpr(self._bwd_fn[j])(
+                            shapes, x_aval, y_aval, key_aval, g_aval
+                        )
+                else:
+                    emb_aval = jax.eval_shape(
+                        lambda: jnp.zeros(
+                            self._param_shapes[0]["wte"]["embedding"].shape,
+                            self.policy.compute_dtype,
+                        )
+                    )
+                    ge_aval = jax.eval_shape(
+                        lambda: jnp.zeros(
+                            self._param_shapes[0]["wte"]["embedding"].shape,
+                            self.policy.reduce_dtype,
+                        )
+                    )
+                    tgt_aval = jax.ShapeDtypeStruct(
+                        (self.micro_batch, self.cfg.model.seq_len), jnp.int32
+                    )
+                    fb = jax.make_jaxpr(self._last_fn)(
+                        shapes, emb_aval, x_aval, tgt_aval, key_aval,
+                        g_aval, ge_aval,
+                    )
+                g_param_aval = jax.eval_shape(
+                    lambda: jax.tree.map(
+                        lambda l: jnp.zeros(
+                            l.shape, self.policy.param_dtype
+                        ),
+                        shapes,
+                    )
+                )
+                upd_args = (
+                    (shapes, self._opt_shapes[j], shapes, g_param_aval, 1.0)
+                    if self.has_ema
+                    else (shapes, self._opt_shapes[j], g_param_aval, 1.0)
+                )
+                upd_jit = jax.jit(
+                    self._upd_fn[j],
+                    donate_argnums=(
+                        ((0, 1, 2, 3) if self.has_ema else (0, 1, 2))
+                        if _DONATE_STAGE_STATE else ()
+                    ),
+                )
+                update_lowered = upd_jit.lower(*upd_args)
+            out.append(
+                {
+                    "stage": j,
+                    "chips": sub.mesh.size,
+                    "fwd_jaxpr": fwd_jaxpr,
+                    "fwd_bwd_jaxpr": fb,
+                    "update_lowered": update_lowered,
+                    # Positions the donation audit must see donated:
+                    # params/opt/[ema]/grads — everything but the
+                    # trailing clip-factor scalar.
+                    "update_donate_expected": (
+                        (0, 1, 2, 3) if self.has_ema else (0, 1, 2)
+                    ),
+                    "params_shapes": shapes,
+                    "boundary_bytes_per_microbatch": int(
+                        self.micro_batch
+                        * self.cfg.model.seq_len
+                        * self.cfg.model.hidden_dim
+                        * np.dtype(self.policy.compute_dtype).itemsize
+                    ),
+                }
+            )
+        return out

@@ -70,6 +70,18 @@ def pipeline_summary(model_cfg) -> str | None:
         return None
     micro = effective_microbatches(model_cfg)
     v = circular_repeat(model_cfg)
+    if getattr(model_cfg, "pipeline_impl", "spmd") == "mpmd":
+        # The MPMD backend (parallel/mpmd_pipeline.py): same fill/drain
+        # bubble fraction, but steady state holds min(S, M) in-flight
+        # microbatch activations instead of M — the number that lets M
+        # grow (and the bubble shrink) without activation memory growing.
+        bubble = (stages - 1) / (micro + stages - 1)
+        return (
+            f"pipeline: {stages} stages x {micro} microbatches "
+            f"[mpmd-1f1b], bubble fraction (S-1)/(M+S-1) = {bubble:.3f}, "
+            f"steady-state live microbatch activations = "
+            f"{min(stages, micro)} (vs {micro} under gpipe)"
+        )
     bubble = (stages - 1) / (v * micro + stages - 1)
     sched = "gpipe" if v == 1 else f"circular(x{v})"
     if getattr(model_cfg, "pipeline_stage_remat", False):

@@ -289,11 +289,18 @@ def validate_ring_schedule(cfg, *, lowp: str | None = None) -> None:
             f"parallel.tp_overlap=true: model family {family!r} has no "
             f"collective-matmul hooks (supported: {SUPPORTED_FAMILIES})"
         )
-    if getattr(cfg.model, "pipeline_stages", 1) > 1:
+    if (
+        getattr(cfg.model, "pipeline_stages", 1) > 1
+        and getattr(cfg.model, "pipeline_impl", "spmd") != "mpmd"
+    ):
+        # The SPMD stage-vmap path owns its own block schedule; the MPMD
+        # backend (ISSUE 14) builds the rings INSIDE each per-stage
+        # program — no stage vmap to collide with.
         raise ValueError(
             "parallel.tp_overlap composes with data/fsdp/model meshes but "
-            "not with pipeline parallelism (the pipeline path owns its own "
-            "block schedule); set model.pipeline_stages=1"
+            "not with the SPMD pipeline backend (the stage-vmap path owns "
+            "its own block schedule); set model.pipeline_stages=1 or "
+            "model.pipeline_impl='mpmd'"
         )
     if cfg.parallel.sequence != "none" or cfg.mesh.seq > 1:
         raise ValueError(

@@ -141,6 +141,26 @@ class Trainer:
         self.model = create_model(cfg.model, self.policy)
         self.tx, self.schedule = make_optimizer(cfg.optimizer, cfg.trainer)
         self.loss_fn = make_loss_fn(self.model, cfg.data.name)
+        # Pipeline backend selection (ISSUE 14): ``pipeline_impl="mpmd"``
+        # replaces the single compiled step with per-stage programs + the
+        # host-side 1F1B driver (parallel/mpmd_pipeline.py). The runner
+        # owns state layout ({"stage_j": ...} trees on pipe-slice
+        # submeshes), per-stage init/shardings, and both steps; the rest
+        # of the Trainer (fit loop, telemetry, checkpointing surface)
+        # drives it through the same train_step/eval_step contract.
+        self._mpmd = None
+        impl = getattr(cfg.model, "pipeline_impl", "spmd")
+        if getattr(cfg.model, "pipeline_stages", 1) > 1:
+            if impl == "mpmd":
+                from frl_distributed_ml_scaffold_tpu.parallel.mpmd_pipeline import (
+                    MpmdPipelineRunner,
+                )
+
+                self._mpmd = MpmdPipelineRunner(cfg, self.env, self.policy)
+            elif impl != "spmd":
+                raise KeyError(
+                    f"unknown model.pipeline_impl={impl!r} (spmd | mpmd)"
+                )
         self.pipeline = build_pipeline(cfg.data, self.env, split="train")
         self._eval_pipeline = None
         self.checkpointer = None  # attached by attach_checkpointer()
@@ -153,13 +173,24 @@ class Trainer:
                 Checkpointer(os.path.join(cfg.workdir, cfg.name, "ckpt"), cfg.checkpoint)
             )
 
-        self._build_state_shardings()
-        if self.overlap_schedule is not None:
-            # Hooks need the partition specs, so they attach only after
-            # the (unhooked) model produced the state shapes above; the
-            # params tree is identical with hooks on or off.
-            self._attach_schedule()
-        self._compile_steps()
+        if self._mpmd is not None:
+            # The runner already derived per-stage shapes/specs/shardings
+            # (and attached the overlap schedule per stage program).
+            self.state_shapes = self._mpmd.state_shapes
+            self.state_specs = self._mpmd.state_specs
+            self.state_shardings = self._mpmd.state_shardings
+            self._train_step_fn = None
+            self._train_step_jit = None
+            self.train_step = self._mpmd.train_step
+            self.eval_step = self._mpmd.eval_step
+        else:
+            self._build_state_shardings()
+            if self.overlap_schedule is not None:
+                # Hooks need the partition specs, so they attach only after
+                # the (unhooked) model produced the state shapes above; the
+                # params tree is identical with hooks on or off.
+                self._attach_schedule()
+            self._compile_steps()
 
     # ---------------------------------------------------------------- setup
 
@@ -282,6 +313,33 @@ class Trainer:
 
     def init_state(self) -> TrainState:
         """Initialize the train state directly into its shardings."""
+        if self._mpmd is not None:
+            state = self._mpmd.init_state()
+            if self.cfg.trainer.init_params_path:
+                host = self._load_init_params_plain(
+                    self.cfg.trainer.init_params_path
+                )
+                new_params = self._mpmd.place_plain_params(host)
+                replacements = {"params": new_params}
+                if state.ema_params is not None:
+                    replacements["ema_params"] = self._mpmd.place_plain_params(
+                        host
+                    )
+                state = state.replace(**replacements)
+            self.logger.info(
+                "initialized %s (mpmd pipeline): %.2fM params over mesh %s",
+                self.cfg.name,
+                tree_param_count(state.params) / 1e6,
+                dict(self.env.mesh.shape),
+            )
+            from frl_distributed_ml_scaffold_tpu.parallel.pipeline import (
+                pipeline_summary,
+            )
+
+            summary = pipeline_summary(self.cfg.model)
+            if summary:
+                self.logger.info("%s", summary)
+            return state
         state = self._mesh_scoped(
             jax.jit(self._init_state_fn, out_shardings=self.state_shardings)
         )(self._rng)
@@ -323,7 +381,27 @@ class Trainer:
             self.logger.info("%s", summary)
         return state
 
-    def _load_init_params(self, path: str):
+    def _load_init_params_plain(self, path: str):
+        """MPMD variant of ``_load_init_params``: checkpoint files carry
+        the PLAIN (stages=1) layout, so validation runs against the plain
+        twin's init shapes; the runner slices the result into per-stage
+        trees (``place_plain_params``)."""
+        import dataclasses as _dc
+
+        plain = create_model(
+            _dc.replace(self.cfg.model, pipeline_stages=1), self.policy
+        )
+        x = example_input(
+            self.cfg.data, self.cfg.model, batch_size=self.env.batch_axis_size
+        )
+        inp = jnp.asarray(x["tokens"][:, :-1])
+        shapes = jax.eval_shape(
+            lambda r: plain.init({"params": r}, inp, train=False)["params"],
+            jax.random.key(0),
+        )
+        return self._load_init_params(path, params_shapes=shapes)
+
+    def _load_init_params(self, path: str, params_shapes=None):
         """Load + validate a flax-msgpack params pytree
         (tools/import_hf_gpt2.py output); returns HOST numpy arrays in the
         policy's param dtype (the caller places them into shardings).
@@ -344,6 +422,7 @@ class Trainer:
             jax.tree_util.keystr(k): tuple(v.shape)
             for k, v in jax.tree_util.tree_leaves_with_path(
                 self.state_shapes.params
+                if params_shapes is None else params_shapes
             )
         }
         if got_paths.keys() != want_paths.keys():
@@ -418,6 +497,10 @@ class Trainer:
         """FLOPs (and, when supported, bytes) of ONE compiled train step.
         Used by bench.py to report model FLOPs and MFU (BASELINE.md
         protocol)."""
+        if self._mpmd is not None:
+            # Per-stage programs have no single lowered step; the runner
+            # sums jaxpr FLOPs over stages x microbatches.
+            return self._mpmd.step_cost_analysis()
         try:
             lowered = self._mesh_scoped(self._train_step_jit.lower)(state, batch)
             # Pre-optimization analysis: no backend compile (the jit call
@@ -599,6 +682,16 @@ class Trainer:
             # absorb the initial XLA compile, not false-fire on it.
             first_beat_scale=cfg.trainer.stall_timeout_first_beat_scale,
         )
+        if self._mpmd is not None:
+            # 1F1B driver telemetry (ISSUE 14): per-stage idle gauges +
+            # bubble fraction + boundary-transfer counter into THIS fit's
+            # registry, stage-lane spans on the tracer, and watchdog
+            # beats from inside the driver loop (a wedged inter-stage
+            # transfer fires the stall dump instead of hanging silently).
+            self._mpmd.attach_telemetry(
+                registry=telem, tracer=tracer, trace=train_trace,
+                watchdog=watchdog,
+            )
         flops_per_step: float | None = None  # lazy; False once probing failed
         window_wait = 0.0
 

@@ -966,6 +966,56 @@ def test_lint_train_step_overlap_recipes_enforce_their_pins():
 
 
 @pytest.mark.fast
+def test_stage_program_lint_clean_and_mutations_trip(monkeypatch):
+    """THE pipeline:stage_program family gates (ISSUE 14). Positive: the
+    MPMD recipe's per-stage programs lint clean at HEAD (free of
+    cross-stage collectives, stage state donated). Mutations: (a) drop
+    the stage update donation — the audit fires `stage-not-donated`;
+    (b) sneak a pipe-axis psum into a stage program — the census check
+    fires `cross-stage-collective` (boundary traffic must be the
+    driver's explicit transfers only)."""
+    from frl_distributed_ml_scaffold_tpu import parallel
+    from frl_distributed_ml_scaffold_tpu.analysis.runner import (
+        lint_stage_programs,
+    )
+    from frl_distributed_ml_scaffold_tpu.parallel import (
+        mpmd_pipeline as mpp,
+    )
+
+    rep = lint_stage_programs(workdir="/tmp/graft_lint_test")
+    assert rep.ok, [f.message for f in rep.errors()]
+    assert rep.meta["pipeline"]["impl"] == "mpmd"
+    assert rep.meta["stages"] == rep.meta["pipeline"]["stages"]
+
+    # (a) dropped stage-state donation.
+    monkeypatch.setattr(mpp, "_DONATE_STAGE_STATE", False)
+    rep_d = lint_stage_programs(workdir="/tmp/graft_lint_test")
+    codes = {f.code for f in rep_d.errors()}
+    assert "stage-not-donated" in codes, codes
+    monkeypatch.setattr(mpp, "_DONATE_STAGE_STATE", True)
+
+    # (b) a collective over the pipe axis inside a stage program.
+    real = mpp._stage_forward
+
+    def sabotaged(module, policy, params_c, x, rng, train):
+        from frl_distributed_ml_scaffold_tpu.dist.mesh import (
+            current_mesh_env,
+        )
+
+        y = real(module, policy, params_c, x, rng, train)
+        env = current_mesh_env()
+        return shard_map_compat(
+            lambda t: jax.lax.psum(t, "pipe"),
+            mesh=env.mesh, in_specs=P(), out_specs=P(),
+        )(y)
+
+    monkeypatch.setattr(mpp, "_stage_forward", sabotaged)
+    rep_c = lint_stage_programs(workdir="/tmp/graft_lint_test")
+    codes = {f.code for f in rep_c.errors()}
+    assert "cross-stage-collective" in codes, codes
+
+
+@pytest.mark.fast
 def test_lint_runner_unknown_recipe_refuses():
     from frl_distributed_ml_scaffold_tpu.analysis.runner import (
         lint_train_step,
@@ -1000,6 +1050,7 @@ def test_cli_all_recipes_runs_clean_and_emits_json(tmp_path):
     assert "serving:decode_step" in programs
     assert "serving:decode_step_int8kv" in programs
     assert "serving:handoff" in programs
+    assert "pipeline:stage_program" in programs
     assert "hygiene:traced-modules" in programs
     assert "robustness:package" in programs
     assert all(r["ok"] for r in reports), [

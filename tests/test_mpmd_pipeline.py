@@ -1,0 +1,487 @@
+"""MPMD pipeline parallelism (ISSUE 14): per-stage programs + host-side
+1F1B driver (parallel/mpmd_pipeline.py) must (i) be loss/token-parity
+with the plain stack, pure DP, and the SPMD pipeline at equal
+(stages, microbatches), (ii) hold only min(S, M) in-flight microbatch
+activations (the 1F1B memory model, pinned against the driver's
+measured counters), (iii) move inter-stage data ONLY as explicit
+transfers (census-pinned in test_graft_lint.py), and (iv) surface
+per-stage telemetry + watchdog beats from the driver loop."""
+
+import dataclasses
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from _jit import jit_apply, jit_init
+
+from frl_distributed_ml_scaffold_tpu.config import apply_overrides, get_config
+from frl_distributed_ml_scaffold_tpu.config.schema import (
+    GPTConfig,
+    PrecisionConfig,
+)
+from frl_distributed_ml_scaffold_tpu.models.gpt import (
+    GPT,
+    mpmd_merge_params,
+    mpmd_stage_params,
+    unstack_pipeline_params,
+)
+from frl_distributed_ml_scaffold_tpu.parallel.mpmd_pipeline import (
+    bubble_fraction,
+    peak_live_activations,
+    stage_peak_live,
+)
+from frl_distributed_ml_scaffold_tpu.precision import get_policy
+from frl_distributed_ml_scaffold_tpu.trainer.loop import Trainer
+
+FP32 = get_policy(PrecisionConfig(policy="fp32"))
+
+TINY = dict(
+    vocab_size=128, num_layers=4, num_heads=2, hidden_dim=32, seq_len=16,
+    dropout=0.0,
+)
+
+GPT_TINY_OVERRIDES = [
+    "model.vocab_size=128",
+    "model.num_layers=4",
+    "model.num_heads=2",
+    "model.hidden_dim=32",
+    "model.seq_len=32",
+    "data.vocab_size=128",
+    "data.seq_len=32",
+    "data.global_batch_size=16",
+    "trainer.grad_accum=1",
+    "optimizer.warmup_steps=0",
+    "precision.policy=fp32",
+    "trainer.log_every=1000",
+]
+
+MPMD = [
+    "model.pipeline_stages=2",
+    "model.pipeline_microbatches=4",
+    "model.pipeline_impl=mpmd",
+    "mesh.pipe=2",
+    "mesh.data=4",
+]
+
+
+def make_trainer(tmp_path, overrides):
+    cfg = apply_overrides(
+        get_config("gpt2_medium_zero1"),
+        GPT_TINY_OVERRIDES + [f"workdir={tmp_path}"] + overrides,
+    )
+    return Trainer(cfg)
+
+
+def run_steps(trainer, state, steps=4):
+    for step in range(steps):
+        batch = trainer.pipeline.global_batch(step)
+        state, metrics = trainer.train_step(state, batch)
+    return state, metrics
+
+
+def max_diff(a, b):
+    return max(
+        jax.tree.leaves(
+            jax.tree.map(
+                lambda x, y: float(
+                    np.max(np.abs(np.asarray(x) - np.asarray(y)))
+                ),
+                a,
+                b,
+            )
+        )
+    )
+
+
+# ------------------------------------------------------- analytic model
+
+
+@pytest.mark.fast
+def test_bubble_and_peak_live_model():
+    """The analytic schedule model (satellite pin): GPipe and 1F1B share
+    the (S-1)/(M+S-1) bubble fraction; 1F1B's win is peak live
+    activations — min(S, M), == S and < M whenever M > S, vs GPipe's M."""
+    for s, m in [(2, 4), (4, 8), (4, 4), (2, 2), (3, 12)]:
+        assert bubble_fraction("1f1b", s, m) == pytest.approx(
+            (s - 1) / (m + s - 1)
+        )
+        assert bubble_fraction("gpipe", s, m) == bubble_fraction("1f1b", s, m)
+        assert peak_live_activations("gpipe", s, m) == m
+        assert peak_live_activations("1f1b", s, m) == min(s, m)
+        if m > s:
+            assert peak_live_activations("1f1b", s, m) == s
+            assert peak_live_activations("1f1b", s, m) < m
+        # Per-stage profile: stage j warms up S-1-j forwards then holds
+        # one in flight — monotone down the pipe.
+        assert [stage_peak_live(j, s, m) for j in range(s)] == [
+            min(s - j, m) for j in range(s)
+        ]
+    with pytest.raises(KeyError, match="schedule"):
+        bubble_fraction("interleaved", 2, 4)
+
+
+@pytest.mark.fast
+def test_stage_params_roundtrip_and_unstack():
+    """mpmd_stage_params slices the plain stack losslessly (stage 0 owns
+    wte/wpe, the last stage ln_f) and both mpmd_merge_params and
+    unstack_pipeline_params invert it exactly."""
+    cfg = GPTConfig(**TINY)
+    tokens = jax.random.randint(jax.random.key(0), (4, 16), 0, 128)
+    params = jit_init(GPT(cfg, FP32), tokens, train=False)["params"]
+    staged = mpmd_stage_params(cfg, params, 2)
+    assert set(staged) == {"stage_0", "stage_1"}
+    assert "wte" in staged["stage_0"] and "wpe" in staged["stage_0"]
+    assert "ln_f" in staged["stage_1"] and "wte" not in staged["stage_1"]
+    for j in range(2):
+        lead = jax.tree.leaves(staged[f"stage_{j}"]["blocks"])[0].shape[0]
+        assert lead == 2  # L/S
+    assert max_diff(params, mpmd_merge_params(cfg, staged)) == 0.0
+    assert max_diff(params, unstack_pipeline_params(cfg, staged)) == 0.0
+    with pytest.raises(ValueError, match="PLAIN"):
+        mpmd_stage_params(cfg, staged, 2)
+
+
+# ------------------------------------------------------------- parity
+
+
+def test_mpmd_forward_and_eval_match_plain(tmp_path):
+    """The per-stage forward chain + tied head == the plain GPT apply,
+    and the runner's eval step reproduces the plain CE exactly."""
+    import optax
+
+    trainer = make_trainer(tmp_path, MPMD)
+    cfg = trainer.cfg
+    plain = GPT(
+        dataclasses.replace(cfg.model, pipeline_stages=1), trainer.policy
+    )
+    batch = trainer.pipeline.global_batch(0)
+    tokens = jnp.asarray(batch["tokens"])
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    params = jit_init(plain, inputs, train=False)["params"]
+    logits_plain = jit_apply(plain, train=False)({"params": params}, inputs)
+    ce_plain = float(
+        optax.softmax_cross_entropy_with_integer_labels(
+            np.asarray(logits_plain, np.float32), np.asarray(targets)
+        ).mean()
+    )
+    mp_params = trainer._mpmd.place_plain_params(jax.device_get(params))
+    logits_mp = trainer._mpmd.apply_logits(mp_params, inputs)
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(logits_mp)),
+        np.asarray(jax.device_get(logits_plain)),
+        atol=2e-5, rtol=1e-5,
+    )
+    state = trainer._mpmd.init_state().replace(params=mp_params)
+    ev = trainer.eval_step(state, batch)
+    assert float(ev["loss"]) == pytest.approx(ce_plain, abs=2e-5)
+
+
+def test_mpmd_e2e_matches_dp(tmp_path):
+    """MPMD PP=2 x DP=4 training == pure DP=8 training, step for step —
+    through the 1F1B driver, explicit transfers, the tied-embedding
+    gradient reduction, and the host-coordinated global grad clip (the
+    recipe's grad_clip_norm=1.0 stays ON)."""
+    dp = make_trainer(tmp_path / "dp", ["mesh.data=8"])
+    mp = make_trainer(tmp_path / "mp", MPMD)
+    dp_state = dp.init_state()
+    plain = jax.device_get(dp_state.params)
+    mp_state = mp.init_state().replace(
+        params=mp._mpmd.place_plain_params(plain)
+    )
+    dp_state, dm = run_steps(dp, dp_state)
+    mp_state, mm = run_steps(mp, mp_state)
+    assert float(mm["loss"]) == pytest.approx(float(dm["loss"]), abs=1e-5)
+    assert float(mm["grad_norm"]) == pytest.approx(
+        float(dm["grad_norm"]), abs=1e-4
+    )
+    merged = mpmd_merge_params(mp.cfg.model, jax.device_get(mp_state.params))
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=5e-4, rtol=1e-4),
+        jax.device_get(dp_state.params),
+        merged,
+    )
+
+
+def test_mpmd_matches_spmd_pipeline(tmp_path):
+    """The acceptance pin: the MPMD backend is loss/param-parity with the
+    SPMD stage-vmap pipeline at equal (pipeline_stages,
+    pipeline_microbatches) on the same pipe-mesh grid."""
+    spmd = make_trainer(
+        tmp_path / "spmd",
+        ["model.pipeline_stages=2", "model.pipeline_microbatches=4",
+         "mesh.pipe=2", "mesh.data=4"],
+    )
+    mp = make_trainer(tmp_path / "mpmd", MPMD)
+    spmd_state = spmd.init_state()
+    # The SPMD init is stage-stacked; route both backends through ONE
+    # plain tree so they start identical.
+    plain = unstack_pipeline_params(
+        spmd.cfg.model, jax.device_get(spmd_state.params)
+    )
+    mp_state = mp.init_state().replace(
+        params=mp._mpmd.place_plain_params(plain)
+    )
+    spmd_state, sm = run_steps(spmd, spmd_state)
+    mp_state, mm = run_steps(mp, mp_state)
+    assert float(mm["loss"]) == pytest.approx(float(sm["loss"]), abs=2e-5)
+    merged = mpmd_merge_params(mp.cfg.model, jax.device_get(mp_state.params))
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=5e-4, rtol=1e-4),
+        unstack_pipeline_params(
+            spmd.cfg.model, jax.device_get(spmd_state.params)
+        ),
+        merged,
+    )
+
+
+def test_mpmd_grad_accum_and_remat_match_dp(tmp_path):
+    """Grad accumulation folds into the 1F1B run as extra microbatches
+    and trainer.remat checkpoints the stage recompute — both must stay
+    numerics-identical to the DP reference with the same knobs."""
+    dp = make_trainer(
+        tmp_path / "dp",
+        ["mesh.data=8", "trainer.grad_accum=2", "trainer.remat=full"],
+    )
+    mp = make_trainer(
+        tmp_path / "mp",
+        ["model.pipeline_stages=2", "model.pipeline_microbatches=2",
+         "model.pipeline_impl=mpmd", "mesh.pipe=2", "mesh.data=4",
+         "trainer.grad_accum=2", "trainer.remat=full"],
+    )
+    assert mp._mpmd.total_micro == 4  # 2 microbatches x 2 accum chunks
+    dp_state = dp.init_state()
+    plain = jax.device_get(dp_state.params)
+    mp_state = mp.init_state().replace(
+        params=mp._mpmd.place_plain_params(plain)
+    )
+    dp_state, _ = run_steps(dp, dp_state, steps=3)
+    mp_state, _ = run_steps(mp, mp_state, steps=3)
+    merged = mpmd_merge_params(mp.cfg.model, jax.device_get(mp_state.params))
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=5e-4, rtol=1e-4),
+        jax.device_get(dp_state.params),
+        merged,
+    )
+
+
+def test_mpmd_composes_with_overlap_schedules(tmp_path):
+    """The PR 13 declarations lower PER STAGE PROGRAM: blockwise fsdp
+    gathers and collective-matmul TP rings inside a stage must match
+    their GSPMD twins exactly — and the stage programs must actually
+    carry the declared collectives (census pin)."""
+    from frl_distributed_ml_scaffold_tpu.analysis.collectives import (
+        collective_census,
+    )
+
+    # fsdp blockwise gathers inside the stage scan body.
+    fs = ["model.pipeline_stages=2", "model.pipeline_microbatches=2",
+          "model.pipeline_impl=mpmd", "mesh.pipe=2", "mesh.fsdp=4",
+          "mesh.data=1", "parallel.param_sharding=fsdp",
+          "parallel.fsdp_min_size=16"]
+    ref = make_trainer(tmp_path / "fs_gspmd", fs)
+    ovl = make_trainer(tmp_path / "fs_ovl", fs + ["parallel.fsdp_overlap=true"])
+    ref_state = ref.init_state()
+    plain = mpmd_merge_params(
+        ref.cfg.model, jax.device_get(ref_state.params)
+    )
+    ovl_state = ovl.init_state().replace(
+        params=ovl._mpmd.place_plain_params(plain)
+    )
+    ref_state, _ = run_steps(ref, ref_state, steps=2)
+    ovl_state, _ = run_steps(ovl, ovl_state, steps=2)
+    assert max_diff(
+        jax.device_get(ref_state.params), jax.device_get(ovl_state.params)
+    ) < 5e-4
+    arts = ovl._mpmd.lint_artifacts()
+    for art in arts:
+        prims = {
+            r.primitive
+            for r in collective_census(art["fwd_bwd_jaxpr"])
+            if "fsdp" in r.axes
+        }
+        assert "all_gather" in prims, (art["stage"], prims)
+
+    # TP rings inside the stage blocks.
+    tp = ["model.pipeline_stages=2", "model.pipeline_microbatches=2",
+          "model.pipeline_impl=mpmd", "mesh.pipe=2", "mesh.data=2",
+          "mesh.model=2"]
+    tref = make_trainer(tmp_path / "tp_gspmd", tp)
+    tovl = make_trainer(tmp_path / "tp_ovl", tp + ["parallel.tp_overlap=true"])
+    tref_state = tref.init_state()
+    tplain = mpmd_merge_params(
+        tref.cfg.model, jax.device_get(tref_state.params)
+    )
+    tovl_state = tovl.init_state().replace(
+        params=tovl._mpmd.place_plain_params(tplain)
+    )
+    tref_state, _ = run_steps(tref, tref_state, steps=2)
+    tovl_state, _ = run_steps(tovl, tovl_state, steps=2)
+    assert max_diff(
+        jax.device_get(tref_state.params), jax.device_get(tovl_state.params)
+    ) < 5e-4
+    for art in tovl._mpmd.lint_artifacts():
+        fwd_census = collective_census(art["fwd_jaxpr"])
+        assert any(
+            r.primitive == "ppermute" and "model" in r.axes
+            for r in fwd_census
+        ), art["stage"]
+        # The rings replace the monolithic gathers on the model axis.
+        assert not any(
+            r.primitive == "all_gather" and "model" in r.axes
+            for r in fwd_census
+        ), art["stage"]
+
+
+# ------------------------------------------- schedule memory + transfers
+
+
+def test_mpmd_peak_live_and_transfer_accounting(tmp_path):
+    """THE 1F1B memory pin: the driver's measured in-flight activation
+    counters equal the analytic per-stage model (min(S-j, M); max over
+    stages min(S, M) == S < M = GPipe), and the explicit boundary
+    transfers account for exactly the bytes the schedule moves."""
+    trainer = make_trainer(tmp_path, MPMD)
+    runner = trainer._mpmd
+    s, m = runner.num_stages, runner.total_micro
+    assert m > s  # the regime where 1F1B beats GPipe's memory
+    state = trainer.init_state()
+    batch = trainer.pipeline.global_batch(0)
+    state, _ = trainer.train_step(state, batch)
+    # Stage j saves boundary inputs for its pending backwards; the last
+    # stage runs fused fwd+bwd and holds none.
+    assert runner.last_peak_live[:-1] == [
+        stage_peak_live(j, s, m) for j in range(s - 1)
+    ]
+    assert max(runner.last_peak_live) == peak_live_activations("1f1b", s, m)
+    assert max(runner.last_peak_live) == s
+    assert max(runner.last_peak_live) < peak_live_activations("gpipe", s, m)
+
+    mcfg = trainer.cfg.model
+    mb = runner.micro_batch
+    t, d, v = mcfg.seq_len, mcfg.hidden_dim, mcfg.vocab_size
+    acts = (s - 1) * m * mb * t * d * 4  # fwd activations, fp32
+    grads = (s - 1) * m * mb * t * d * 4  # bwd cotangents
+    toks = m * mb * t * 4 * 2  # stage-0 inputs + last-stage targets
+    emb = v * d * 4 * 2  # tied-embedding mirror out + head grad back
+    assert runner.last_boundary_bytes == acts + grads + toks + emb
+
+
+def test_mpmd_telemetry_gauges_and_watchdog_beats(tmp_path):
+    """Satellite 4 wiring, unit level: per-stage idle gauges + the
+    analytic bubble gauge + the boundary-transfer counter land in the
+    attached registry, and the 1F1B driver beats the watchdog from
+    INSIDE its dispatch loop (so a wedged transfer fires the stall
+    dump)."""
+    from frl_distributed_ml_scaffold_tpu.telemetry import MetricsRegistry
+
+    class BeatStub:
+        beats = 0
+
+        def beat(self):
+            self.beats += 1
+
+    trainer = make_trainer(tmp_path, MPMD)
+    runner = trainer._mpmd
+    reg = MetricsRegistry()
+    stub = BeatStub()
+    runner.attach_telemetry(registry=reg, watchdog=stub)
+    state = trainer.init_state()
+    state, _ = trainer.train_step(state, trainer.pipeline.global_batch(0))
+    snap = reg.snapshot()
+    s, m = runner.num_stages, runner.total_micro
+    assert snap["pipeline_bubble_fraction"] == pytest.approx(
+        bubble_fraction("1f1b", s, m)
+    )
+    for j in range(s):
+        assert f"pipeline_stage{j}_idle_s" in snap
+        assert snap[f"pipeline_stage{j}_idle_s"] >= 0.0
+    assert (
+        snap["pipeline_boundary_transfer_bytes_total"]
+        == runner.last_boundary_bytes
+    )
+    # One beat per dispatched stage op + one per stage update: stages
+    # 0..S-2 run 2M ops (F+B), the last stage M fused ops.
+    assert stub.beats == (s - 1) * 2 * m + m + s
+
+
+@pytest.mark.obs
+def test_mpmd_fit_exports_stage_telemetry(tmp_path):
+    """End-to-end: a 2-step mpmd fit() exports the stage gauges through
+    the standard telemetry.jsonl, and tools/telemetry_report.py renders
+    them (the satellite's visibility requirement)."""
+    trainer = make_trainer(
+        tmp_path, MPMD + ["trainer.log_every=1", "trainer.total_steps=2"]
+    )
+    trainer.fit(num_steps=2)
+    run_dir = os.path.join(str(tmp_path), trainer.cfg.name)
+    telem_path = os.path.join(run_dir, "telemetry.jsonl")
+    assert os.path.exists(telem_path)
+    import tools.telemetry_report as treport
+
+    rep = treport.report(treport.load(telem_path))
+    scalars = rep["scalars"]
+    assert "pipeline_bubble_fraction" in scalars
+    assert scalars["pipeline_bubble_fraction"] == pytest.approx(
+        bubble_fraction("1f1b", 2, 4)
+    )
+    for j in range(2):
+        assert f"pipeline_stage{j}_idle_s" in scalars
+    assert scalars["pipeline_boundary_transfer_bytes_total"] > 0
+
+
+# --------------------------------------------------- generate + refusals
+
+
+def test_mpmd_params_generate_like_plain(tmp_path):
+    """Decode runs on the plain stack: generation._plain_stack restacks
+    MPMD per-stage params automatically (unstack_pipeline_params'
+    stage_0 branch), so an mpmd-trained checkpoint generates without
+    config surgery."""
+    from frl_distributed_ml_scaffold_tpu.models.generation import generate
+
+    cfg = GPTConfig(**TINY)
+    tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, 128)
+    plain = GPT(cfg, FP32)
+    params = jit_init(plain, tokens, train=False)["params"]
+    pp_cfg = dataclasses.replace(
+        cfg, pipeline_stages=2, pipeline_impl="mpmd"
+    )
+    staged = mpmd_stage_params(cfg, params, 2)
+    prompt = np.asarray(tokens[:, :5])
+    out_plain = generate(plain, params, prompt, max_new_tokens=4)
+    out_mpmd = generate(GPT(pp_cfg, FP32), staged, prompt, max_new_tokens=4)
+    np.testing.assert_array_equal(
+        np.asarray(out_plain), np.asarray(out_mpmd)
+    )
+
+
+@pytest.mark.fast
+def test_mpmd_refusals(tmp_path):
+    """Config combinations the MPMD backend cannot honor must refuse at
+    Trainer construction with actionable messages, not mis-train."""
+    with pytest.raises(ValueError, match="MoE"):
+        make_trainer(
+            tmp_path / "moe",
+            MPMD + ["model.moe.num_experts=4", "mesh.data=1",
+                    "mesh.expert=4"],
+        )
+    with pytest.raises(ValueError, match="circular"):
+        make_trainer(
+            tmp_path / "circ", MPMD + ["model.pipeline_circular_repeat=2"]
+        )
+    with pytest.raises(ValueError, match="pipe"):
+        make_trainer(
+            tmp_path / "mesh",
+            ["model.pipeline_stages=4", "model.pipeline_impl=mpmd",
+             "model.pipeline_microbatches=4", "mesh.pipe=2", "mesh.data=4"],
+        )
+    with pytest.raises(KeyError, match="pipeline_impl"):
+        make_trainer(
+            tmp_path / "impl",
+            ["model.pipeline_stages=2", "model.pipeline_impl=banana",
+             "mesh.pipe=2", "mesh.data=4"],
+        )

@@ -197,7 +197,10 @@ def test_pp_moe_aux_loss_batch_invariant():
     "local attention, while pp x dense/flash and plain ring/ulysses are all "
     "exact — NOT a tolerance class (do not re-tolerance; see CHANGES.md "
     "PR 3 / memory repo-test-flakiness). Tracked in BACKLOG R8-2; "
-    "strict=True so a fixed jaxlib un-xfails this loudly.",
+    "strict=True so a fixed jaxlib un-xfails this loudly. RESOLVED on the "
+    "MPMD backend (ISSUE 14): test_pp_composes_with_ring_attention_mpmd "
+    "passes the same composition through per-stage programs with no "
+    "stage vmap — pp x SP users should run model.pipeline_impl=mpmd.",
 )
 def test_pp_composes_with_ring_attention():
     """Round-1 exclusion, lifted: ring attention's shard_map (ppermute over
@@ -275,7 +278,9 @@ def test_pp_composes_with_remat(tmp_path):
     "test_pp_composes_with_ring_attention (the composition, not the "
     "attention impl, is what breaks — Ulysses' all_to_all shows the "
     "identical diff). Tracked in BACKLOG R8-2; strict=True so a fixed "
-    "jaxlib un-xfails this loudly.",
+    "jaxlib un-xfails this loudly. RESOLVED on the MPMD backend (ISSUE "
+    "14): test_pp_composes_with_ulysses_attention_mpmd passes the same "
+    "composition through per-stage programs with no stage vmap.",
 )
 def test_pp_composes_with_ulysses_attention():
     """Ulysses' all_to_all shard_map also batches over the stage vmap."""
@@ -297,6 +302,84 @@ def test_pp_composes_with_ulysses_attention():
             lambda p, t: m_pp.apply({"params": p}, t, train=False)
         )(plain_to_pipelined(params, 2), tokens)
     np.testing.assert_allclose(out_plain, out_pp, atol=2e-5, rtol=1e-5)
+
+
+def test_pp_composes_with_ring_attention_mpmd(tmp_path):
+    """BACKLOG R8-2, resolved on the MPMD path (ISSUE 14): the per-stage
+    programs have no vmap(spmd_axis_name), so ring attention's shard_map
+    (ppermute over ``seq``) opens directly inside each stage program —
+    the pipe2 x data2 x seq2 composition that deterministically diverges
+    under the SPMD stage vmap (the strict-xfail twin above) must PASS
+    here, forward AND through two finite training steps."""
+    import dataclasses as _dc
+
+    trainer = make_gpt_trainer(
+        tmp_path,
+        [
+            "model.pipeline_stages=2",
+            "model.pipeline_microbatches=2",
+            "model.pipeline_impl=mpmd",
+            "model.attention=ring",
+            "mesh.pipe=2",
+            "mesh.data=2",
+            "mesh.seq=2",
+        ],
+    )
+    plain = GPT(
+        _dc.replace(
+            trainer.cfg.model, pipeline_stages=1, attention="dense"
+        ),
+        trainer.policy,
+    )
+    tokens = jax.random.randint(jax.random.key(4), (8, 32), 0, 128)
+    params = jit_init(plain, tokens, train=False)["params"]
+    out_plain = jit_apply(plain, train=False)({"params": params}, tokens)
+    mp_params = trainer._mpmd.place_plain_params(jax.device_get(params))
+    out_mpmd = trainer._mpmd.apply_logits(mp_params, tokens)
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(out_mpmd)),
+        np.asarray(jax.device_get(out_plain)),
+        atol=2e-5, rtol=1e-5,
+    )
+    state = trainer.init_state().replace(params=mp_params)
+    state, metrics = run_steps(trainer, state, steps=2)
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_pp_composes_with_ulysses_attention_mpmd(tmp_path):
+    """Ulysses' all_to_all shard_map through the MPMD per-stage programs:
+    the second half of the R8-2 pair, passing where the stage-vmap twin
+    strict-xfails."""
+    import dataclasses as _dc
+
+    trainer = make_gpt_trainer(
+        tmp_path,
+        [
+            "model.pipeline_stages=2",
+            "model.pipeline_microbatches=2",
+            "model.pipeline_impl=mpmd",
+            "model.attention=ulysses",
+            "mesh.pipe=2",
+            "mesh.data=2",
+            "mesh.seq=2",
+        ],
+    )
+    plain = GPT(
+        _dc.replace(
+            trainer.cfg.model, pipeline_stages=1, attention="dense"
+        ),
+        trainer.policy,
+    )
+    tokens = jax.random.randint(jax.random.key(5), (8, 32), 0, 128)
+    params = jit_init(plain, tokens, train=False)["params"]
+    out_plain = jit_apply(plain, train=False)({"params": params}, tokens)
+    mp_params = trainer._mpmd.place_plain_params(jax.device_get(params))
+    out_mpmd = trainer._mpmd.apply_logits(mp_params, tokens)
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(out_mpmd)),
+        np.asarray(jax.device_get(out_plain)),
+        atol=2e-5, rtol=1e-5,
+    )
 
 
 def test_pp_composes_with_flash_attention_pallas(monkeypatch):

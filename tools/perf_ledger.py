@@ -73,6 +73,15 @@ PAGED_SERVING_PROGRAM = "serving:decode_step_paged"
 VERIFY_SERVING_PROGRAM = "serving:verify_step_paged"
 HANDOFF_PROGRAM = "serving:handoff"
 
+#: MPMD pipeline per-stage rows (ISSUE 14): one row per stage program of
+#: the tiny-twin MPMD recipe — census/FLOPs of the microbatch
+#: fwd+bwd program, the analytic 1F1B bubble/peak-live model, and the
+#: explicit boundary-transfer bytes the driver moves per microbatch
+#: (which is the whole inter-stage communication story: stage programs
+#: are census-pinned collective-free across stages by graft-lint).
+MPMD_RECIPE = "gpt2_pipeline_mpmd"
+MPMD_STAGE_PREFIX = "pipeline:stage"
+
 #: Analytic row fields --check compares EXACTLY. Everything else in a row
 #: (intensity, roofline, measured) is either derived from these or
 #: measured wall time. ``schedule`` makes the rows per-SCHEDULE (ISSUE
@@ -279,6 +288,65 @@ def analytic_serving_row(
     return row
 
 
+def analytic_stage_rows(workdir: str = "/tmp/perf_ledger") -> dict:
+    """Per-stage rows for the MPMD pipeline recipe (ISSUE 14): stage j's
+    row carries the jaxpr FLOPs + collective census of its microbatch
+    fwd+bwd program (within-stage collectives only — the graft-lint
+    ``pipeline:stage_program`` family errors on any ``pipe``-axis
+    collective), the analytic 1F1B schedule model (bubble fraction,
+    whole-schedule and per-stage peak live activations — pinned against
+    the driver's measured counters in tests/test_mpmd_pipeline.py), and
+    the explicit activation-transfer bytes per microbatch boundary."""
+    import jax
+
+    from frl_distributed_ml_scaffold_tpu.analysis.collectives import (
+        census_summary,
+        collective_census,
+    )
+    from frl_distributed_ml_scaffold_tpu.analysis.runner import (
+        _build_trainer,
+    )
+    from frl_distributed_ml_scaffold_tpu.parallel.mpmd_pipeline import (
+        bubble_fraction,
+        peak_live_activations,
+        stage_peak_live,
+    )
+    from frl_distributed_ml_scaffold_tpu.utils.flops import jaxpr_flops
+
+    trainer = _build_trainer(MPMD_RECIPE, workdir)
+    runner = trainer._mpmd
+    s, mt = runner.num_stages, runner.total_micro
+    rows = {}
+    for art in runner.lint_artifacts():
+        j = art["stage"]
+        census = collective_census(art["fwd_bwd_jaxpr"])
+        flops = jaxpr_flops(art["fwd_bwd_jaxpr"])
+        comm = sum(r.total_bytes for r in census)
+        rows[f"{MPMD_STAGE_PREFIX}{j}"] = {
+            "flops_per_step": flops,
+            "collective_bytes_per_step": comm,
+            "collectives": {
+                prim: agg
+                for prim, agg in sorted(census_summary(census).items())
+            },
+            "params_bytes": _tree_bytes(art["params_shapes"]),
+            "chips": art["chips"],
+            "schedule": {
+                "declared": f"pipeline(mpmd,1f1b,stages={s},micro={mt})",
+                "short": "1f1b",
+            },
+            "bubble_fraction": bubble_fraction("1f1b", s, mt),
+            "peak_live_activations": peak_live_activations("1f1b", s, mt),
+            "stage_peak_live": stage_peak_live(j, s, mt),
+            "boundary_bytes_per_microbatch": art[
+                "boundary_bytes_per_microbatch"
+            ],
+            "intensity_flops_per_byte": round(flops / max(comm, 1), 3),
+            "roofline": _roofline(flops, comm, art["chips"]),
+        }
+    return rows
+
+
 def measure_recipe(name: str, steps: int, workdir: str) -> dict:
     """The measured half: a tiny real fit on the CPU sim, reading the
     step-time percentiles the telemetry layer already computes. Wall
@@ -426,6 +494,12 @@ def build_ledger(
         # analytic face of serve_bench's *_disagg tail-isolation columns.
         print(f"perf_ledger: tracing {HANDOFF_PROGRAM}", flush=True)
         rows[HANDOFF_PROGRAM] = analytic_serving_row(handoff=True)
+    # MPMD pipeline per-stage rows (ISSUE 14): analytic-only — the
+    # measured A/B vs the SPMD backend rides perf_sweep
+    # gpt2_pipeline_mpmd (BACKLOG R17-1).
+    print(f"perf_ledger: tracing {MPMD_STAGE_PREFIX}* "
+          f"({MPMD_RECIPE})", flush=True)
+    rows.update(analytic_stage_rows(workdir))
     from frl_distributed_ml_scaffold_tpu.utils.flops import (
         peak_flops_per_chip,
     )
@@ -449,8 +523,27 @@ def check_ledger(
     """Drift findings (empty = green). Analytic fields compare exactly;
     measured step time within a factor of ``tol`` when re-measured."""
     problems: list[str] = []
+    stage_rows: dict | None = None  # rebuilt once on first pipeline: row
     for program, base in sorted(baseline.get("rows", {}).items()):
-        if program in (
+        if program.startswith(MPMD_STAGE_PREFIX):
+            if stage_rows is None:
+                try:
+                    stage_rows = analytic_stage_rows(workdir)
+                except Exception as e:
+                    problems.append(
+                        f"{program}: stage rows no longer trace "
+                        f"({type(e).__name__}: {e})"
+                    )
+                    stage_rows = {}
+            cur = stage_rows.get(program)
+            if cur is None:
+                if stage_rows:
+                    problems.append(
+                        f"{program}: baseline stage row no longer produced "
+                        f"(stages: {sorted(stage_rows)})"
+                    )
+                continue
+        elif program in (
             SERVING_PROGRAM, PAGED_SERVING_PROGRAM, VERIFY_SERVING_PROGRAM,
             HANDOFF_PROGRAM,
         ):
@@ -487,7 +580,9 @@ def check_ledger(
                     f"{json.dumps(cur.get(key))}"
                 )
         for extra in ("cache_bytes", "splice_table_bytes",
-                      "splice_blocks_written", "splice_block_bytes"):
+                      "splice_blocks_written", "splice_block_bytes",
+                      "bubble_fraction", "peak_live_activations",
+                      "stage_peak_live", "boundary_bytes_per_microbatch"):
             if extra in base and base[extra] != cur.get(extra):
                 problems.append(
                     f"{program}: {extra} drifted — baseline "

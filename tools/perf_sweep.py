@@ -486,6 +486,45 @@ def gpt2_fsdp_tp_overlap():
             )
 
 
+def gpt2_pipeline_mpmd():
+    """The MPMD-vs-SPMD pipeline backend A/B (ISSUE 14, queued as
+    BACKLOG R17-1 for the next multi-chip relay window): the gpt2_pp
+    operating point (4 stages x 8 microbatches) under the stage-vmap
+    GPipe program vs the per-stage-program 1F1B driver
+    (model.pipeline_impl) — the step-time delta reads as
+    schedule+memory-profile win alone (loss/token parity is sim-gated in
+    tests/test_mpmd_pipeline.py). Needs >= 4 devices for the pipe axis;
+    capture a trace and check the driver's explicit device_put transfers
+    overlap the per-stage compute (trace_analyze lanes), plus HBM
+    headroom at larger microbatch counts — 1F1B's min(S, M) live
+    activations vs GPipe's M is the lever that buys bigger M (smaller
+    bubble) at flat memory."""
+    import jax
+
+    n = jax.device_count()
+    if n < 4:
+        print(json.dumps({
+            "experiment": "gpt2_pipeline_mpmd",
+            "skipped": f"needs >=4 devices for the pipe axis (have {n})",
+        }), flush=True)
+        return
+    for impl in ("spmd", "mpmd"):
+        for micro in (8, 16):
+            bs = 64
+            measure_or_emit(
+                "gpt2_pipeline_mpmd", bs, "gpt2_pipeline_mpmd",
+                [
+                    f"model.pipeline_impl={impl}",
+                    f"model.pipeline_microbatches={micro}",
+                    "mesh.pipe=4",
+                    f"mesh.data={n // 4}",
+                    f"data.global_batch_size={bs}",
+                ],
+                {"impl": impl, "microbatches": micro, "n_chips": n},
+                n=10, warm=3,
+            )
+
+
 def rn50_fused_bn():
     """The priced HBM-ceiling fix, bought (BACKLOG R5-4): the roofline
     pins ~150 ms of the 227 ms headline step in BN-backward HBM traffic
@@ -509,7 +548,8 @@ GROUPS = {f.__name__: f for f in (rn50_bs, rn50_precision, rn50_fwd_only,
                                   gpt2_block_remat, gpt2_offload,
                                   rn50_fused_opt, rn50_fused_bn,
                                   moe_dispatch, gpt2_fsdp_overlap,
-                                  gpt2_tp_overlap, gpt2_fsdp_tp_overlap)}
+                                  gpt2_tp_overlap, gpt2_fsdp_tp_overlap,
+                                  gpt2_pipeline_mpmd)}
 
 if __name__ == "__main__":
     which = sys.argv[1:] or list(GROUPS)
