@@ -1214,6 +1214,246 @@ def lint_paged_decode_step(
     return report
 
 
+#: The redistribution executor's same-mesh program classes (ISSUE 15),
+#: one per seam shape, on the 8-device sim. ``reshard:<src>to<dst>``
+#: naming; ``even_src`` derives the source from the restore layout
+#: (redistribute.restore_layout_spec — the elastic-restore seam's even
+#: read), ``no_gather`` arms the zero-all_gather pin (a pure axis MOVE
+#: must be ONE all_to_all; any all_gather means replicated staging).
+RESHARD_PROGRAMS: dict[str, dict] = {
+    "reshard:fsdp_to_tp": dict(
+        mesh=dict(data=1, fsdp=4, model=2), shape=(64, 64),
+        src=("fsdp", None), dst=(None, "model"),
+    ),
+    "reshard:tp_row_to_col": dict(
+        mesh=dict(data=1, model=8), shape=(64, 64),
+        src=("model", None), dst=(None, "model"), no_gather=True,
+    ),
+    "reshard:restore_even_to_fsdp": dict(
+        mesh=dict(data=2, fsdp=4), shape=(64, 64),
+        src=None, dst=("fsdp", None), even_src=True,
+    ),
+}
+
+
+def build_reshard_program(name: str):
+    """One redistribution executor program as an ABSTRACT artifact:
+    ``(plan, jaxpr, lowered)`` — the jaxpr is the EXACT
+    ``redistribute.executor.collective_callable`` the executor jits
+    (same body, same shard_map specs), so the linted artifact and the
+    executed one cannot drift; the lowered form carries the executor's
+    donation (``donate_argnums=(0,)``). Shared with the perf ledger's
+    ``redistribute:*`` rows."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from frl_distributed_ml_scaffold_tpu.dist.mesh import (
+        MeshConfig,
+        build_mesh,
+    )
+    from frl_distributed_ml_scaffold_tpu.redistribute import (
+        compile_leaf_plan,
+        restore_layout_spec,
+    )
+    from frl_distributed_ml_scaffold_tpu.redistribute.executor import (
+        collective_callable,
+    )
+
+    if name not in RESHARD_PROGRAMS:
+        raise ValueError(
+            f"unknown reshard program {name!r} "
+            f"(have {sorted(RESHARD_PROGRAMS)})"
+        )
+    cfg = RESHARD_PROGRAMS[name]
+    env = build_mesh(MeshConfig(**cfg["mesh"]))
+    shape = cfg["shape"]
+    dst_spec = P(*cfg["dst"])
+    src_spec = (
+        restore_layout_spec(shape, dst_spec, env.mesh)
+        if cfg.get("even_src")
+        else P(*cfg["src"])
+    )
+    plan = compile_leaf_plan(
+        shape, jnp.float32,
+        NamedSharding(env.mesh, src_spec),
+        NamedSharding(env.mesh, dst_spec),
+        path=name,
+    )
+    if plan.kind != "collective":
+        raise RuntimeError(
+            f"{name}: expected a collective plan, compiled {plan.kind!r} "
+            "— the program classes graft-lint pins must stay on the "
+            "collective executor"
+        )
+    fn = collective_callable(plan)
+    jaxpr = jax.make_jaxpr(fn)(jax.ShapeDtypeStruct(shape, jnp.float32))
+    lowered = jax.jit(fn, donate_argnums=(0,)).lower(
+        jax.ShapeDtypeStruct(
+            shape, jnp.float32, sharding=plan.src_sharding
+        )
+    )
+    return plan, jaxpr, lowered
+
+
+def _shard_map_inner(jaxpr):
+    """The shard_map eqn's body jaxpr (per-device LOCAL shapes — the
+    altitude the scratch budget is written at; the outer eqn's outvar is
+    the global array, which no single device materializes)."""
+    for eqn in jaxpr.jaxpr.eqns:
+        if "shard_map" in eqn.primitive.name:
+            return eqn.params["jaxpr"]
+    return jaxpr
+
+
+def lint_reshard(name: str) -> Report:
+    """Lint one redistribution executor program (ISSUE 15) — the
+    zero-replicated-staging contract (arXiv 2112.01075), three teeth:
+
+    - materialization budget == the plan's ``peak_scratch_bytes`` (one
+      source shard + one destination shard per device), checked on the
+      shard_map BODY: a naive gather-then-scatter materializes the full
+      logical array on every device and trips it;
+    - pure axis MOVES (``no_gather`` programs) additionally pin ZERO
+      all_gather: the move is ONE all_to_all — any gather is staging;
+    - donation audit: the executor's jitted program donates its source
+      (or every reshard holds two copies live).
+
+    Mutation-gated in tests/test_graft_lint.py via the executor's
+    ``_NAIVE_GATHER_SCATTER`` reference switch."""
+    from frl_distributed_ml_scaffold_tpu.analysis.donation import (
+        lowered_donations,
+    )
+    from frl_distributed_ml_scaffold_tpu.analysis.materialization import (
+        oversized_intermediates,
+    )
+
+    report = Report(program=name)
+    plan, jaxpr, lowered = build_reshard_program(name)
+    census = collective_census(jaxpr)
+    report.meta["collective_census"] = [r.to_dict() for r in census]
+    report.meta["plan"] = plan.to_dict()
+
+    budget = plan.peak_scratch_bytes
+    for i in oversized_intermediates(_shard_map_inner(jaxpr), budget):
+        report.add(
+            "materialization", "error", "replicated-staging",
+            f"reshard program materializes {i.dtype}{list(i.shape)} "
+            f"({i.bytes} bytes > the {budget}-byte scratch budget, "
+            f"{i.primitive}) per device — a redistribution must move "
+            "shard deltas, never stage the logical array",
+            intermediate=i.to_dict(), budget_bytes=budget,
+        )
+    if RESHARD_PROGRAMS[name].get("no_gather"):
+        for r in census:
+            if "all_gather" in r.primitive:
+                report.add(
+                    "reshard", "error", "gather-on-move",
+                    f"pure axis move carries an all_gather of "
+                    f"{[list(s) for s in r.shapes]} — the move is ONE "
+                    "all_to_all; a gather is replicated staging",
+                    primitive=r.primitive,
+                    shapes=[list(s) for s in r.shapes],
+                )
+    dons = lowered_donations(lowered)
+    if sum(1 for d in dons if d.donated) < 1:
+        report.add(
+            "donation", "error", "source-not-donated",
+            "reshard program does not donate its source array — every "
+            "redistribution holds two copies live",
+        )
+    else:
+        report.add(
+            "donation", "info", "summary",
+            f"source donated; plan moves {plan.bytes_moved} bytes "
+            f"(lower bound {plan.bytes_lower_bound}) at peak scratch "
+            f"{plan.peak_scratch_bytes}",
+        )
+    return report
+
+
+def lint_reshard_programs() -> list[Report]:
+    """All registered ``reshard:*`` executor program classes."""
+    return [lint_reshard(name) for name in sorted(RESHARD_PROGRAMS)]
+
+
+def build_tiny_gpt():
+    """THE shrink-shape GPT twin for the redistribute seam artifacts —
+    one definition shared by ``build_train_to_serve_plan`` (perf ledger
+    + CLI train→serve seam) and ``tools/reshard_plan.py``'s restore /
+    respread seams, so editing the twin cannot desynchronize the gated
+    ledger row from the operator dry-runs. Returns ``(model,
+    abstract_params)``; nothing runs."""
+    import jax
+    import jax.numpy as jnp
+
+    from frl_distributed_ml_scaffold_tpu.config.schema import (
+        GPTConfig,
+        PrecisionConfig,
+    )
+    from frl_distributed_ml_scaffold_tpu.models.gpt import GPT
+    from frl_distributed_ml_scaffold_tpu.precision import get_policy
+
+    model = GPT(
+        GPTConfig(
+            vocab_size=128, num_layers=2, num_heads=4, hidden_dim=64,
+            seq_len=32, dropout=0.0,
+        ),
+        get_policy(PrecisionConfig(policy="fp32")),
+    )
+    params = jax.eval_shape(
+        lambda: model.init(
+            {"params": jax.random.key(0)},
+            jnp.zeros((2, 8), jnp.int32), train=False,
+        )["params"]
+    )
+    return model, params
+
+
+def build_train_to_serve_plan():
+    """The tiny-GPT train→serve handoff as an ABSTRACT tree plan: params
+    shaped/sharded the way the fsdp×model trainer would hold them
+    (fsdp=4 × model=2 over the 8-device sim), re-planned onto a 2-device
+    serving TP mesh — nothing runs. ONE twin shared by the perf-ledger
+    ``redistribute:train_to_serve`` row and the ``reshard_plan.py``
+    CLI, so the gated numbers and the operator's dry-run cannot
+    drift."""
+    import jax
+
+    from frl_distributed_ml_scaffold_tpu import redistribute
+    from frl_distributed_ml_scaffold_tpu.config.schema import ParallelConfig
+    from frl_distributed_ml_scaffold_tpu.dist.mesh import (
+        MeshConfig,
+        build_mesh,
+    )
+    from frl_distributed_ml_scaffold_tpu.models.gpt import gpt_tp_rules
+    from frl_distributed_ml_scaffold_tpu.parallel.partition import (
+        param_specs,
+        shardings_from_specs,
+    )
+
+    _model, params = build_tiny_gpt()
+    train_env = build_mesh(MeshConfig(data=1, fsdp=4, model=2))
+    p_specs = param_specs(
+        params,
+        ParallelConfig(param_sharding="fsdp", fsdp_min_size=16),
+        train_env.mesh,
+        gpt_tp_rules(),
+    )
+    src_sh = shardings_from_specs(p_specs, train_env.mesh)
+    params = jax.tree.map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        params, src_sh,
+    )
+    serve_env = build_mesh(
+        MeshConfig(data=1, model=2), devices=jax.devices()[:2]
+    )
+    plan = redistribute.train_to_serve_plan(
+        params, serve_env, gpt_tp_rules()
+    )
+    return plan, train_env, serve_env
+
+
 def lint_hygiene(paths: Iterable[str] | None = None) -> Report:
     """AST hygiene lint over the repo's traced modules."""
     import glob
@@ -1271,6 +1511,7 @@ def lint_all(
     *,
     recipes: Iterable[str] | None = None,
     serving: bool = True,
+    reshard: bool = True,
     hygiene: bool = True,
     robustness: bool = True,
     workdir: str = "/tmp/graft_lint",
@@ -1323,6 +1564,11 @@ def lint_all(
         # re-own pinned clone-free — zero collectives, no logical-cache
         # copy, pool donated.
         emit(lint_handoff())
+    if reshard:
+        # The redistribution executor's program classes (ISSUE 15):
+        # same-mesh reshards pinned staging-free + donated.
+        for r in lint_reshard_programs():
+            emit(r)
     if hygiene:
         emit(lint_hygiene())
     if robustness:

@@ -72,6 +72,10 @@ class Checkpointer:
         #: Committed steps that failed to restore (bit rot, truncated
         #: arrays): reported by restore_or_init, left on disk.
         self.corrupt_steps: list[int] = []
+        #: The last redistribution plan a ``via_redistribution`` restore
+        #: executed (ISSUE 15) — its bytes_moved / peak_scratch_bytes
+        #: are the migration's cost record (None until one runs).
+        self.last_restore_plan = None
 
     # ------------------------------------------------------ commit markers
 
@@ -254,29 +258,105 @@ class Checkpointer:
             reader.close()
         return restored.params
 
-    def restore(self, state_shapes: Any, state_shardings: Any, step: int | None = None):
-        """Restore into the given shardings (resharding as needed)."""
+    def restore(
+        self,
+        state_shapes: Any,
+        state_shardings: Any,
+        step: int | None = None,
+        *,
+        via_redistribution: bool = False,
+    ):
+        """Restore into the given shardings (resharding as needed).
+
+        ``via_redistribution`` (ISSUE 15, the elastic-restore seam):
+        instead of asking Orbax for the target layout directly, restore
+        each leaf at the memory-efficient EVEN layout
+        (``redistribute.restore_layout_spec`` — the target spec with
+        every unused mesh axis overlaid, so each device reads ~1/N of
+        the leaf and no replicated copy is ever staged, even for leaves
+        whose target IS replication), then run the redistribution plan
+        executor on-device (donated-in-place, pure atom-drop collective
+        programs by construction) to the target shardings. Bit-identical
+        to the direct path; the executed plan is recorded on
+        ``last_restore_plan`` for cost attribution."""
         step = self.latest_step() if step is None else step
         if step is None:
             return None
-        abstract = jax.tree.map(
-            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        if via_redistribution and jax.process_count() > 1:
+            # The executor's scope is single-controller today (its
+            # chunked fallback needs every shard addressable, and the
+            # multi-controller collective path is unproven on this
+            # backend — see docs/operations.md "State redistribution");
+            # a multi-process restore takes the direct Orbax read
+            # rather than risking a cross-process wedge mid-reform.
+            self.logger.warning(
+                "restore_redistribute requested under %d processes: "
+                "falling back to the direct Orbax resharding read "
+                "(the redistribution executor is single-controller)",
+                jax.process_count(),
+            )
+            via_redistribution = False
+        if not via_redistribution:
+            abstract = jax.tree.map(
+                lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+                state_shapes,
+                state_shardings,
+            )
+            restored = self._mngr.restore(
+                step, args=ocp.args.StandardRestore(abstract)
+            )
+            self.logger.info(
+                "restored checkpoint step %d from %s", step, self.directory
+            )
+            return restored
+        from jax.sharding import NamedSharding
+
+        from frl_distributed_ml_scaffold_tpu.redistribute import (
+            compile_tree_plan,
+            execute,
+            restore_layout_spec,
+        )
+
+        even = jax.tree.map(
+            lambda s, sh: jax.ShapeDtypeStruct(
+                s.shape, s.dtype,
+                sharding=NamedSharding(
+                    sh.mesh, restore_layout_spec(s.shape, sh.spec, sh.mesh)
+                ),
+            ),
             state_shapes,
             state_shardings,
         )
-        restored = self._mngr.restore(step, args=ocp.args.StandardRestore(abstract))
-        self.logger.info("restored checkpoint step %d from %s", step, self.directory)
+        restored = self._mngr.restore(step, args=ocp.args.StandardRestore(even))
+        scratch = (
+            int(self.cfg.redistribute_scratch_mb * 1024 * 1024)
+            if getattr(self.cfg, "redistribute_scratch_mb", 0)
+            else None
+        )
+        plan = compile_tree_plan(
+            restored, state_shardings, scratch_limit_bytes=scratch
+        )
+        restored = execute(plan, restored, donate=True)
+        self.last_restore_plan = plan
+        self.logger.info(
+            "restored checkpoint step %d from %s via redistribution "
+            "(%d leaves, %d bytes moved, lower bound %d, peak scratch %d)",
+            step, self.directory, len(plan.leaves), plan.bytes_moved,
+            plan.bytes_lower_bound, plan.peak_scratch_bytes,
+        )
         return restored
 
     def _restore_bridging_ema(
-        self, shapes: Any, shardings: Any, step: int
+        self, shapes: Any, shardings: Any, step: int,
+        *, via_redistribution: bool = False,
     ) -> TrainState:
         """One step's restore, bridging an ema_decay toggle across the
         resume (the checkpoint has/lacks the ema_params subtree relative
         to the new run's target) — a corrupt step raises out of BOTH
         attempts and the caller falls back down the chain."""
+        via = via_redistribution
         try:
-            return self.restore(shapes, shardings, step)
+            return self.restore(shapes, shardings, step, via_redistribution=via)
         except Exception:
             if shapes.ema_params is not None:
                 # New run wants EMA, checkpoint predates it: restore without
@@ -285,6 +365,7 @@ class Checkpointer:
                     shapes.replace(ema_params=None),
                     shardings.replace(ema_params=None),
                     step,
+                    via_redistribution=via,
                 )
                 self.logger.warning(
                     "checkpoint step %d has no ema_params (ema_decay was "
@@ -304,6 +385,7 @@ class Checkpointer:
                 shapes.replace(ema_params=shapes.params),
                 shardings.replace(ema_params=shardings.params),
                 step,
+                via_redistribution=via,
             )
             self.logger.warning(
                 "checkpoint step %d carries ema_params but ema_decay=0 now: "
@@ -321,9 +403,17 @@ class Checkpointer:
             )
         steps = self.all_steps()
         shapes, shardings = trainer.state_shapes, trainer.state_shardings
+        # ISSUE 15: a reformed (different-topology) mesh restores through
+        # the redistribution service — even-layout read + on-device plan
+        # execution — instead of Orbax's direct target-layout read. The
+        # committed-chain fallback below is unchanged: a torn/corrupt
+        # step fails out of either path identically.
+        via = bool(getattr(self.cfg, "restore_redistribute", False))
         for step in reversed(steps):
             try:
-                return self._restore_bridging_ema(shapes, shardings, step)
+                return self._restore_bridging_ema(
+                    shapes, shardings, step, via_redistribution=via
+                )
             except Exception as e:
                 # Bit rot / truncation a commit marker cannot see: report
                 # it, keep the directory for inspection, fall back to the

@@ -245,6 +245,20 @@ class CheckpointConfig:
     max_to_keep: int = 3
     async_save: bool = True
     resume: bool = True  # restore latest checkpoint if present
+    # Restore through the redistribution service (ISSUE 15): each leaf
+    # is read at a memory-efficient EVEN layout (every device reads
+    # ~1/N — never a replicated staging copy, even for leaves whose
+    # target is replication) and then redistributed on-device to the
+    # trainer's target shardings by redistribute/'s plan executor. The
+    # elastic supervisor's reform path forces this on (a reformed mesh
+    # is exactly the saved-on-any-mesh/restored-on-any-other case);
+    # default off so unchanged-topology resumes keep the direct Orbax
+    # path bit-for-bit.
+    restore_redistribute: bool = False
+    # Scratch budget for the redistribution's bounded chunking, MiB.
+    # 0 = auto (one destination shard + one chunk per leaf — the plan
+    # compiler's own ceiling).
+    redistribute_scratch_mb: int = 0
 
 
 @dataclass(frozen=True)

@@ -553,6 +553,12 @@ def supervise(args, cfg: ExperimentConfig) -> int:
             held_port.close()
             held_port = None
         cmd = _child_command(args, topo)
+        # A reformed world restores a checkpoint written on a DIFFERENT
+        # topology: switch the child onto the redistribution restore
+        # path (ISSUE 15 — even-layout read + on-device plan execution,
+        # no replicated staging) instead of the fixed-layout Orbax read.
+        # Appended after _child_command's forced overrides so it wins.
+        cmd += ["checkpoint.restore_redistribute=true"]
         restarts = 0
         consecutive_failures = 0
         grow_grace = 3 if reason == "growing" else 0

@@ -380,6 +380,40 @@ def blocks_for_tokens(tokens: int, block_size: int) -> int:
     return -(-int(tokens) // int(block_size))
 
 
+def pool_heads_axis(name: str, leaf) -> int | None:
+    """The taxonomy's third question (ISSUE 15): which axis of a POOL
+    leaf carries the attention heads — the only axis a serving re-spread
+    may shard. Name-keyed like ``POOL_LEAF_OF`` (pool shapes are
+    ambiguous): K/V pools are ``[..., N, bs, H, hd]`` (heads at
+    ``ndim-2``), scale pools ``[..., N, bs, H]`` (heads at ``ndim-1``);
+    every other leaf (tables, cursors) carries none. The engine's
+    ``respread_pool`` derives its destination layouts through this —
+    the same lockstep contract as the shape taxonomy: a new pool leaf
+    class extends THIS function, not an ad-hoc ndim check."""
+    if name in ("key_pool", "value_pool"):
+        return leaf.ndim - 2
+    if name in ("key_pool_scale", "value_pool_scale"):
+        return leaf.ndim - 1
+    return None
+
+
+def pool_leaf_spec(name: str, leaf):
+    """Destination PartitionSpec for one paged-cache leaf under a model
+    axis (the ``models/gpt.py _constrain_kv_pool`` layout, derived from
+    the name taxonomy): pool leaves shard heads over ``model`` and are
+    REPLICATED over every batch axis (blocks are shared across slot
+    rows); bookkeeping leaves replicate. ``None`` = no opinion (carry
+    the leaf's current spec)."""
+    from jax.sharding import PartitionSpec as P
+
+    ax = pool_heads_axis(name, leaf)
+    if ax is None:
+        return None
+    entries = [None] * leaf.ndim
+    entries[ax] = "model"
+    return P(*entries)
+
+
 def splice_pool_blocks(cache, slot_cache, blk_ids, m0, slot, *,
                        block_size: int):
     """The prefill→decode HANDOFF SPLICE (ISSUE 12), over the block-pool

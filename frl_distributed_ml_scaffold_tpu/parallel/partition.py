@@ -213,16 +213,37 @@ def shardings_from_specs(specs: Any, mesh: Mesh) -> Any:
 
 
 def shard_params_for_serving(params: Any, env: Any, rules: PartitionRules) -> Any:
-    """Place an (unsharded) params tree onto a serving mesh per the model's
-    TP rules — the one-call version of derive-specs + device_put that
-    every decode consumer (serving/engine.py callers, tools/serve_bench.py,
+    """Place a params tree onto a serving mesh per the model's TP rules
+    — the one-call version of derive-specs + device_put that every
+    decode consumer (serving/engine.py callers, tools/serve_bench.py,
     the sharded-decode tests) otherwise hand-rolls.
 
     Serving has no optimizer state and no FSDP overlay — params are
     either replicated or Megatron-sharded over ``model`` — so the overlay
     config is the default ``ParallelConfig()`` (replicated base) and only
     ``rules`` decides placement. The head-sharded KV cache then follows
-    from these kernels at trace time (models/gpt.py pins the layout)."""
+    from these kernels at trace time (models/gpt.py pins the layout).
+
+    Device-resident SHARDED trees (a live training layout — the
+    train→serve handoff, ISSUE 15) route through the redistribution
+    service: each leaf moves only the shard deltas the destination
+    layout lacks, never a replicated host round-trip. Host (numpy)
+    trees — and multi-process trees whose shards this process cannot
+    address (the executor is single-controller) — keep the direct
+    shard-wise ``device_put``."""
+    leaves = jax.tree.leaves(params)
+    if any(
+        isinstance(getattr(l, "sharding", None), NamedSharding)
+        for l in leaves
+    ) and all(
+        getattr(l, "is_fully_addressable", True) for l in leaves
+    ):
+        from frl_distributed_ml_scaffold_tpu.redistribute import (
+            train_to_serve,
+        )
+
+        placed, _plan = train_to_serve(params, env, rules)
+        return placed
     specs = param_specs(params, ParallelConfig(), env.mesh, rules)
     return jax.tree.map(
         lambda p, s: jax.device_put(p, NamedSharding(env.mesh, s)),

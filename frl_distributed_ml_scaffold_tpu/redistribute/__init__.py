@@ -1,0 +1,226 @@
+"""Mesh-to-mesh state redistribution service (ISSUE 15, ROADMAP item 4).
+
+One subsystem for every "move state between layouts" seam the scaffold
+has grown: a plan compiler (redistribute/plan.py — minimal chunked
+collective programs with a bounded scratch budget, the arXiv 2112.01075
+contract), an executor that runs plans donated-in-place
+(redistribute/executor.py), and a cost model the perf ledger prices
+(``RedistributionPlan.bytes_moved`` / ``bytes_lower_bound`` /
+``peak_scratch_bytes``). The named seams:
+
+- **elastic restore** — ``checkpoint.restore_or_init`` with
+  ``checkpoint.restore_redistribute=true`` (the elastic supervisor's
+  reform path forces it): restore a checkpoint saved on ANY mesh at a
+  memory-efficient even layout (each device reads ~1/N), then
+  redistribute on-device to the trainer's target shardings;
+- **train→serve handoff** — ``train_to_serve(params, serve_env,
+  rules)``: reshard fsdp×model training params onto a serving TP
+  layout on-device (adopted by ``shard_params_for_serving`` /
+  ``build_engine(rules=...)`` / the disaggregated PrefillWorker);
+- **serving re-spread** — ``ServingEngine.respread_pool(new_env)``:
+  re-spread the paged KV pool (+ scale leaves + block tables) when the
+  model axis grows or shrinks, composing with park/resume so in-flight
+  requests survive token-identically.
+
+Graft-lint's ``reshard:*`` program family pins the executor's
+same-mesh collective programs (materialization <= the scratch budget,
+source donated); docs/operations.md "State redistribution" is the
+operator face.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from frl_distributed_ml_scaffold_tpu.redistribute.executor import (
+    collective_callable,
+    collective_program,
+    execute,
+    execute_leaf,
+)
+from frl_distributed_ml_scaffold_tpu.redistribute.plan import (
+    Chunk,
+    LeafPlan,
+    RedistributionPlan,
+    Transition,
+    analyze_transition,
+    compile_leaf_plan,
+    compile_tree_plan,
+    restore_layout_spec,
+)
+
+__all__ = [
+    "Chunk",
+    "LeafPlan",
+    "RedistributionPlan",
+    "Transition",
+    "analyze_transition",
+    "collective_callable",
+    "collective_program",
+    "compile_leaf_plan",
+    "compile_tree_plan",
+    "execute",
+    "execute_leaf",
+    "mesh_shardings",
+    "redistribute_tree",
+    "restore_layout_spec",
+    "serve_shardings",
+    "spec_on",
+    "to_mesh",
+    "train_to_serve",
+    "train_to_serve_plan",
+]
+
+
+def redistribute_tree(
+    tree: Any,
+    dst_shardings: Any,
+    *,
+    donate: bool = False,
+    scratch_limit_bytes: int | None = None,
+) -> tuple[Any, RedistributionPlan]:
+    """Compile + execute in one call; returns ``(new_tree, plan)``."""
+    plan = compile_tree_plan(
+        tree, dst_shardings, scratch_limit_bytes=scratch_limit_bytes
+    )
+    return execute(plan, tree, donate=donate), plan
+
+
+def spec_on(mesh, leaf, spec):
+    """Carry a PartitionSpec onto another mesh, degrading per-axis: any
+    spec entry whose axis no longer divides the dim (or no longer
+    exists) is dropped to replication for THAT dim — the honest
+    cross-topology transfer rule (a model axis of 2 re-spread to 4
+    keeps P(...'model'...) as long as heads still divide)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    entries = list(spec) + [None] * (len(leaf.shape) - len(spec))
+    out = []
+    for dim, e in zip(leaf.shape, entries):
+        if e is None:
+            out.append(None)
+            continue
+        names = (e,) if isinstance(e, str) else tuple(e)
+        prod = 1
+        ok = True
+        for n in names:
+            if n not in sizes:
+                ok = False
+                break
+            prod *= sizes[n]
+        out.append(e if ok and prod and dim % prod == 0 else None)
+    return NamedSharding(mesh, P(*out))
+
+
+def mesh_shardings(
+    tree: Any,
+    env_or_mesh: Any,
+    *,
+    spec_of: Callable[[str, Any], Any] | None = None,
+) -> Any:
+    """Destination shardings for moving ``tree`` onto another mesh with
+    leaf specs carried over (``spec_on`` degradation rules).
+    ``spec_of(path, leaf)`` overrides the destination PartitionSpec per
+    leaf (None = keep the source's); leaves without a NamedSharding
+    (host arrays, single-device) default to replication unless
+    ``spec_of`` says otherwise. Split out of ``to_mesh`` so callers can
+    COMPILE plans before mutating any state (the respread_pool
+    compile-before-park discipline)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    mesh = getattr(env_or_mesh, "mesh", env_or_mesh)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    dst = []
+    for kp, leaf in flat:
+        path = jax.tree_util.keystr(kp)
+        spec = spec_of(path, leaf) if spec_of is not None else None
+        if spec is None:
+            src = getattr(leaf, "sharding", None)
+            spec = getattr(src, "spec", None)
+        if spec is None:
+            spec = P()
+        dst.append(spec_on(mesh, leaf, spec))
+    return jax.tree_util.tree_unflatten(treedef, dst)
+
+
+def to_mesh(
+    tree: Any,
+    env_or_mesh: Any,
+    *,
+    spec_of: Callable[[str, Any], Any] | None = None,
+    donate: bool = False,
+    scratch_limit_bytes: int | None = None,
+) -> tuple[Any, RedistributionPlan]:
+    """Move a device tree onto another mesh (``mesh_shardings`` +
+    compile + execute in one call)."""
+    return redistribute_tree(
+        tree,
+        mesh_shardings(tree, env_or_mesh, spec_of=spec_of),
+        donate=donate,
+        scratch_limit_bytes=scratch_limit_bytes,
+    )
+
+
+def serve_shardings(params: Any, serve_env: Any, rules: Any = None) -> Any:
+    """Destination shardings for the train→serve handoff: the model's
+    TP ``rules`` over a replicated base on ``serve_env``'s mesh (no
+    FSDP overlay — serving has no optimizer), exactly the derivation
+    ``parallel.partition.shard_params_for_serving`` uses."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from frl_distributed_ml_scaffold_tpu.config.schema import ParallelConfig
+    from frl_distributed_ml_scaffold_tpu.parallel.partition import (
+        PartitionRules,
+        param_specs,
+    )
+
+    rules = rules if rules is not None else PartitionRules()
+    specs = param_specs(params, ParallelConfig(), serve_env.mesh, rules)
+    spec_leaves = jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P))
+    flat, treedef = jax.tree_util.tree_flatten(params)
+    return jax.tree_util.tree_unflatten(
+        treedef,
+        [spec_on(serve_env.mesh, l, s) for l, s in zip(flat, spec_leaves)],
+    )
+
+
+def train_to_serve_plan(
+    params: Any,
+    serve_env: Any,
+    rules: Any = None,
+    *,
+    scratch_limit_bytes: int | None = None,
+) -> RedistributionPlan:
+    """Compile (only) the train→serve handoff plan — works on abstract
+    trees (ShapeDtypeStructs carrying shardings), which is how the
+    perf-ledger ``redistribute:train_to_serve`` row and the
+    ``reshard_plan.py --dry-run`` CLI price a migration that never
+    runs."""
+    return compile_tree_plan(
+        params, serve_shardings(params, serve_env, rules),
+        scratch_limit_bytes=scratch_limit_bytes,
+    )
+
+
+def train_to_serve(
+    params: Any,
+    serve_env: Any,
+    rules: Any = None,
+    *,
+    donate: bool = False,
+    scratch_limit_bytes: int | None = None,
+) -> tuple[Any, RedistributionPlan]:
+    """The train→serve param handoff (seam 2): reshard a (typically
+    fsdp×model-sharded) training params tree onto ``serve_env``'s
+    serving TP layout on-device — destination specs from
+    ``serve_shardings``, moved by the plan executor instead of a
+    replicated host round-trip. Returns ``(placed_params, plan)``; the
+    plan's ``bytes_moved``/``peak_scratch_bytes`` are what the
+    perf-ledger ``redistribute:train_to_serve`` row prices."""
+    plan = train_to_serve_plan(
+        params, serve_env, rules, scratch_limit_bytes=scratch_limit_bytes
+    )
+    return execute(plan, params, donate=donate), plan

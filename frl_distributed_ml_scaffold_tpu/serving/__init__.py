@@ -27,12 +27,30 @@ from frl_distributed_ml_scaffold_tpu.serving.scheduler import (
 )
 
 
-def build_engine(model, params, *, serving, **kw):
+def build_engine(model, params, *, serving, rules=None, **kw):
     """Config-driven engine construction: dispatch on
     ``serving.disaggregate`` (ISSUE 12) so callers holding a
     ``ServingConfig`` get the right engine without knowing both
     constructors. ``kw`` passes through (num_slots, eos_id, tenants,
-    prefill_env, telemetry, ...)."""
+    prefill_env, telemetry, ...).
+
+    ``rules`` (ISSUE 15): the model's TP partition rules — when given
+    and a mesh context is live, params are placed onto the serving
+    layout first, via ``parallel.partition.shard_params_for_serving``
+    (which routes device-resident training layouts through the
+    redistribution service: the train→serve handoff moves only shard
+    deltas, never a replicated host round-trip)."""
+    if rules is not None:
+        from frl_distributed_ml_scaffold_tpu.dist.mesh import (
+            current_mesh_env,
+        )
+        from frl_distributed_ml_scaffold_tpu.parallel.partition import (
+            shard_params_for_serving,
+        )
+
+        env = current_mesh_env()
+        if env is not None:
+            params = shard_params_for_serving(params, env, rules)
     cls = DisaggServingEngine if serving.disaggregate else ServingEngine
     return cls(model, params, serving=serving, **kw)
 

@@ -677,6 +677,18 @@ class ServingEngine:
             "serve_prefix_hit_rate",
             help="prefix hits / admissions since engine start",
         )
+        # Live re-spread observability (ISSUE 15). Always registered
+        # (the full-catalog contract): 0 until a respread_pool call.
+        self._m_respread = t.counter(
+            "serve_pool_respread_total",
+            help="live model-axis re-spreads of the paged pool "
+            "(redistribution service; in-flight slots park/resume)",
+        )
+        self._m_respread_bytes = t.counter(
+            "serve_pool_respread_bytes_total",
+            help="bytes the re-spread plans actually moved across "
+            "devices (the shard delta, not the pool size)",
+        )
         # Speculative-decode observability (ISSUE 11). Always registered
         # (the full-catalog contract): 0 with speculate=off.
         self._m_spec_proposed = t.counter(
@@ -2070,6 +2082,157 @@ class ServingEngine:
             request=req.id, reason=reason, n_tokens=len(parked["tokens"]),
         )
         req.span.end(finish_reason=reason, n_tokens=len(parked["tokens"]))
+
+    def respread_pool(self, new_env, *, scratch_limit_bytes=None) -> dict:
+        """Live model-axis RE-SPREAD (ISSUE 15, the serving-autoscaling
+        seam): move the engine — params, the paged KV pool with its
+        quantization-scale leaves, and every cursor/table leaf — onto
+        ``new_env``'s mesh when the model axis grows or shrinks, without
+        dropping in-flight work:
+
+        1. every active slot PARKS (free under the paged pool — the PR
+           12 machinery: blocks stay owned, the reservation stays
+           accounted, zero device work);
+        2. the redistribution service moves params (specs carried over,
+           per-axis degradation) and the cache tree (pool leaves re-spread
+           over heads per the ``generation.pool_heads_axis`` taxonomy;
+           block ids are LOGICAL, so tables, the allocator free list,
+           refcounts, and the prefix cache all survive untouched);
+        3. the jitted program caches are dropped (they traced under the
+           old mesh) and every parked slot RESUMES — decode continues
+           token-identically (sharded == replicated is the pinned decode
+           contract; the RNG is sharding-invariant by construction).
+
+        ``new_env`` is a ``MeshEnv`` or an int model-axis size (a
+        model-only mesh over the first N devices). Returns the executed
+        plans (``{"params": ..., "cache": ..., "draft_params": ...}``)
+        for cost attribution — ``bytes_moved`` is the shard delta, not
+        the pool size. The move is DONATED end to end (the subsystem's
+        in-place contract: peak transient ~= one leaf's src + dst, not
+        two trees): the engine takes ownership of the param buffers it
+        was constructed with, so callers sharing that exact tree with
+        another consumer must re-place their copy first."""
+        if not self.paged:
+            raise ValueError(
+                "respread_pool is a paged-engine contract "
+                "(serving.kv_block_size > 0): the bucketed cache has no "
+                "shared pool to re-spread"
+            )
+        from frl_distributed_ml_scaffold_tpu import redistribute
+        from frl_distributed_ml_scaffold_tpu.dist.mesh import (
+            MeshConfig as _MeshCfg,
+            build_mesh,
+        )
+        from frl_distributed_ml_scaffold_tpu.models.generation import (
+            pool_leaf_spec,
+        )
+
+        if isinstance(new_env, int):
+            n = new_env
+            new_env = build_mesh(
+                _MeshCfg(data=1, model=n), devices=jax.devices()[:n]
+            )
+        n_model = new_env.axis_size("model")
+        if n_model > 1 and self.model.config.num_heads % n_model != 0:
+            raise ValueError(
+                f"model axis {n_model} does not divide num_heads="
+                f"{self.model.config.num_heads} — the pool shards heads"
+            )
+        t0 = time.perf_counter()
+        # COMPILE every plan before touching any engine state: plan
+        # errors (unclean layouts, indivisible dims, non-addressable
+        # shards caught at chunking) surface with nothing parked and
+        # nothing donated.
+        plans: dict[str, Any] = {}
+        plans["params"] = redistribute.compile_tree_plan(
+            self.params,
+            redistribute.mesh_shardings(self.params, new_env),
+            scratch_limit_bytes=scratch_limit_bytes,
+        )
+        if self._draft is not None:
+            plans["draft_params"] = redistribute.compile_tree_plan(
+                self._draft[1],
+                redistribute.mesh_shardings(self._draft[1], new_env),
+                scratch_limit_bytes=scratch_limit_bytes,
+            )
+        if self.cache is not None:
+            from flax.traverse_util import flatten_dict, unflatten_dict
+
+            flat = flatten_dict(self.cache)
+            dst = {}
+            for kp, leaf in flat.items():
+                spec = pool_leaf_spec(kp[-1], leaf)
+                if spec is None:
+                    spec = getattr(
+                        getattr(leaf, "sharding", None), "spec", None
+                    )
+                if spec is None:
+                    from jax.sharding import PartitionSpec as P
+
+                    spec = P()
+                dst[kp] = redistribute.spec_on(new_env.mesh, leaf, spec)
+            plans["cache"] = redistribute.compile_tree_plan(
+                self.cache, unflatten_dict(dst),
+                scratch_limit_bytes=scratch_limit_bytes,
+            )
+        parked = [
+            (int(s), self.park_slot(int(s)))
+            for s in np.flatnonzero(self._active)
+        ]
+        try:
+            self.params = redistribute.execute(
+                plans["params"], self.params, donate=True
+            )
+            if self._draft is not None:
+                dm, dp = self._draft
+                self._draft = (
+                    dm,
+                    redistribute.execute(
+                        plans["draft_params"], dp, donate=True
+                    ),
+                )
+            if self.cache is not None:
+                self.cache = redistribute.execute(
+                    plans["cache"], self.cache, donate=True
+                )
+        except BaseException:
+            # A mid-move failure leaves the device state partially
+            # migrated (donation is per-leaf) — the engine cannot
+            # safely resume decoding, but the NEVER-HANGS contract
+            # survives: every parked request resolves typed "error"
+            # (blocks + reservations released, host-side only) instead
+            # of being stranded in an unreachable parked dict.
+            for _slot, p in parked:
+                self.retire_parked(p, "error")
+            raise
+        # Programs traced under the old mesh are unusable (and would
+        # silently recompute on stale shardings): drop every jit cache;
+        # they rebuild lazily under the new mesh context.
+        self._env = new_env
+        self._prefill_jit.clear()
+        self._decode_jit.clear()
+        self._graft_jit.clear()
+        self._grow_jit.clear()
+        self._paged_decode_jit = None
+        self._prefill_seeded_jit.clear()
+        self._seed_jit.clear()
+        self._paged_graft_jit.clear()
+        self._verify_jit = None
+        self._rewind_jit = None
+        self._draft_jit = None
+        self._tables_dirty = True
+        for slot, p in parked:
+            self.resume_parked(p, slot)
+        moved = sum(p.bytes_moved for p in plans.values())
+        self.stats["respread"] += 1
+        self._m_respread.inc()
+        self._m_respread_bytes.inc(moved)
+        self._phase(
+            "respread", t0=t0, dur_s=time.perf_counter() - t0,
+            trace=self._engine_trace, model_axis=n_model,
+            bytes_moved=moved, parked=len(parked),
+        )
+        return plans
 
     def _finishes(self, slot: int, tok: int) -> bool:
         req = self._req[slot]

@@ -210,8 +210,33 @@ class PrefillWorker:
         #: replicated onto it and prefills run (async) there.
         self.partition = partition
         self._shared_env = shared_env
+        #: Cost record of the replica placement when it rode the
+        #: redistribution service (the Checkpointer.last_restore_plan
+        #: convention); None on the shared partition / device_put path.
+        self.replica_plan = None
         if partition is not None:
-            params = jax.device_put(params, partition.replicated())
+            # The worker's replica rides the redistribution service
+            # (ISSUE 15) when the decode-side shards are addressable
+            # (single-process): leaf-at-a-time bounded assembly with a
+            # plan recording what moved. Multi-process falls back to
+            # the plain device_put — the chunked executor needs every
+            # source shard in-process, and a worker replica must never
+            # fail to construct over an accounting nicety.
+            import jax as _jax
+
+            from frl_distributed_ml_scaffold_tpu import redistribute
+            from jax.sharding import PartitionSpec as P
+
+            addressable = all(
+                getattr(l, "is_fully_addressable", True)
+                for l in _jax.tree_util.tree_leaves(params)
+            )
+            if addressable:
+                params, self.replica_plan = redistribute.to_mesh(
+                    params, partition, spec_of=lambda _p, _l: P()
+                )
+            else:
+                params = jax.device_put(params, partition.replicated())
         self.params = params
         self._prefill_jit: dict[int, Any] = {}
         self._seeded_jit: dict[tuple[int, int], Any] = {}

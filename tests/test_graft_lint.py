@@ -632,6 +632,44 @@ def test_handoff_lint_clean_and_gather_mutation_trips():
         pins.assert_no_dim_materialized(mut_jaxpr, seq_len)
 
 
+def test_reshard_lint_clean_and_naive_mutation_trips(monkeypatch):
+    """ISSUE 15's gates on the redistribution executor's same-mesh
+    program classes: at HEAD every ``reshard:*`` program passes (every
+    per-device intermediate inside the plan's scratch budget, the pure
+    axis-move all_gather-free, source donated), and the canonical
+    regression — the NAIVE gather-then-scatter executor, which stages
+    the full logical array on every device before re-slicing — trips
+    the replicated-staging materialization pin on every program (plus
+    the gather-on-move pin on the pure all_to_all class)."""
+    import frl_distributed_ml_scaffold_tpu.redistribute.executor as rd_exec
+    from frl_distributed_ml_scaffold_tpu.analysis.runner import (
+        RESHARD_PROGRAMS,
+        build_reshard_program,
+        lint_reshard,
+        lint_reshard_programs,
+    )
+
+    reports = lint_reshard_programs()
+    assert {r.program for r in reports} == set(RESHARD_PROGRAMS)
+    for rep in reports:
+        assert rep.ok, (rep.program, [f.message for f in rep.errors()])
+        assert rep.meta["plan"]["bytes_moved"] == (
+            rep.meta["plan"]["bytes_lower_bound"]
+        ), rep.program
+    # The pure-move program really is ONE all_to_all on the wire.
+    plan, jaxpr, _ = build_reshard_program("reshard:tp_row_to_col")
+    pins.assert_collective_present(jaxpr, "all_to_all")
+    pins.assert_no_collective(jaxpr, "all_gather")
+
+    monkeypatch.setattr(rd_exec, "_NAIVE_GATHER_SCATTER", True)
+    for name in RESHARD_PROGRAMS:
+        rep = lint_reshard(name)
+        codes = {f.code for f in rep.errors()}
+        assert "replicated-staging" in codes, (name, codes)
+        if RESHARD_PROGRAMS[name].get("no_gather"):
+            assert "gather-on-move" in codes, (name, codes)
+
+
 @pytest.mark.fast
 def test_mutation_dropped_donation_is_caught():
     """THE donation mutation gate: the same program jitted with and
@@ -1051,6 +1089,9 @@ def test_cli_all_recipes_runs_clean_and_emits_json(tmp_path):
     assert "serving:decode_step_int8kv" in programs
     assert "serving:handoff" in programs
     assert "pipeline:stage_program" in programs
+    assert "reshard:fsdp_to_tp" in programs
+    assert "reshard:tp_row_to_col" in programs
+    assert "reshard:restore_even_to_fsdp" in programs
     assert "hygiene:traced-modules" in programs
     assert "robustness:package" in programs
     assert all(r["ok"] for r in reports), [

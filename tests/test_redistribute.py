@@ -1,0 +1,677 @@
+"""Redistribution-service tier (ISSUE 15): plan compiler, executors, and
+the three wired seams.
+
+The acceptance headline this file pins: moving state between meshes is
+BIT-IDENTICAL to the replicated-staging reference while the executor's
+transient stays inside the plan's scratch budget — no full replicated
+copy is ever materialized (the arXiv 2112.01075 contract) — and the
+three seams hold their composition contracts: elastic restore falls back
+down the committed chain exactly like the direct path (the PR 9
+torn-write shape, now on a reformed mesh), train→serve params serve
+token-identically, and a live pool re-spread preserves decode token
+identity for in-flight slots.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import pytest as _pytest_mark
+
+pytestmark = _pytest_mark.mark.redist
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from _jit import jit_init
+
+from frl_distributed_ml_scaffold_tpu import redistribute as rd
+from frl_distributed_ml_scaffold_tpu.config import apply_overrides, get_config
+from frl_distributed_ml_scaffold_tpu.config.schema import (
+    GPTConfig,
+    ParallelConfig,
+    PrecisionConfig,
+)
+from frl_distributed_ml_scaffold_tpu.dist.mesh import (
+    MeshConfig,
+    build_mesh,
+    mesh_context,
+)
+from frl_distributed_ml_scaffold_tpu.models.generation import generate
+from frl_distributed_ml_scaffold_tpu.models.gpt import GPT, gpt_tp_rules
+from frl_distributed_ml_scaffold_tpu.parallel.partition import (
+    param_specs,
+    shard_params_for_serving,
+    shardings_from_specs,
+)
+from frl_distributed_ml_scaffold_tpu.precision import get_policy
+from frl_distributed_ml_scaffold_tpu.redistribute import executor as rd_exec
+from frl_distributed_ml_scaffold_tpu.serving import ServingEngine, build_engine
+from frl_distributed_ml_scaffold_tpu.trainer.loop import Trainer
+
+FP32 = get_policy(PrecisionConfig(policy="fp32"))
+
+TINY = dict(
+    vocab_size=64, num_layers=2, num_heads=4, hidden_dim=64, seq_len=64,
+    dropout=0.0,
+)
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    model = GPT(GPTConfig(**TINY), FP32)
+    tokens = jax.random.randint(jax.random.key(1), (2, 8), 0, 64)
+    params = jit_init(model, tokens, train=False)["params"]
+    return model, params, tokens
+
+
+def _bits(x) -> bytes:
+    """Bit-exact comparison handle for any dtype (fp8 included)."""
+    return np.asarray(jax.device_get(x)).tobytes()
+
+
+def _mesh(devices=None, **kw):
+    return build_mesh(MeshConfig(**kw), devices=devices)
+
+
+# ------------------------------------------------------------- plan model
+
+
+@pytest.mark.fast
+def test_identity_plan_is_noop():
+    env = _mesh(data=2, fsdp=4)
+    x = jax.device_put(
+        np.arange(64.0, dtype=np.float32).reshape(8, 8),
+        NamedSharding(env.mesh, P("fsdp", None)),
+    )
+    out, plan = rd.redistribute_tree(
+        {"w": x}, {"w": NamedSharding(env.mesh, P("fsdp", None))}
+    )
+    assert plan.leaves[0].kind == "identity"
+    assert plan.bytes_moved == 0 and plan.peak_scratch_bytes == 0
+    assert out["w"] is x
+
+
+@pytest.mark.fast
+def test_plan_costs_moved_equals_shard_delta_floor():
+    """The 2112.01075 minimality claim as a number: every plan the
+    compiler emits moves exactly the bytes each destination shard lacks
+    (no gather-everything round-trips hiding in the chunk lists)."""
+    env = _mesh(data=1, fsdp=4, model=2)
+    serve = _mesh(devices=jax.devices()[:2], data=1, model=2)
+    x = jax.ShapeDtypeStruct(
+        (64, 64), jnp.float32,
+        sharding=NamedSharding(env.mesh, P("fsdp", "model")),
+    )
+    for dst in (
+        NamedSharding(env.mesh, P(None, "model")),
+        NamedSharding(env.mesh, P()),
+        NamedSharding(serve.mesh, P("model", None)),
+    ):
+        plan = rd.compile_leaf_plan((64, 64), jnp.float32, x.sharding, dst)
+        assert plan.bytes_moved == plan.bytes_lower_bound, (
+            str(dst.spec), plan.to_dict(),
+        )
+    # Replication is the one destination whose per-device need IS the
+    # whole leaf; a sharded destination must stay under it.
+    sharded = rd.compile_leaf_plan(
+        (64, 64), jnp.float32, x.sharding,
+        NamedSharding(env.mesh, P(None, "model")),
+    )
+    assert sharded.peak_scratch_bytes < sharded.leaf_bytes
+
+
+@pytest.mark.fast
+def test_scratch_limit_splits_chunks():
+    env = _mesh(data=1, fsdp=4, model=2)
+    serve = _mesh(devices=jax.devices()[:2], data=1, model=2)
+    src = NamedSharding(env.mesh, P("fsdp", None))
+    dst = NamedSharding(serve.mesh, P(None, "model"))
+    small = rd.compile_leaf_plan(
+        (64, 64), jnp.float32, src, dst, scratch_limit_bytes=1024
+    )
+    big = rd.compile_leaf_plan((64, 64), jnp.float32, src, dst)
+    assert len(small.chunks) > len(big.chunks)
+    assert max(c.nbytes for c in small.chunks) <= 1024
+    # Identical cost model either way: chunking changes granularity,
+    # never WHAT moves.
+    assert small.bytes_moved == big.bytes_moved
+
+
+def test_restore_layout_spec_overlays_unused_axes():
+    env = _mesh(data=2, fsdp=4)
+    spec = rd.restore_layout_spec((64, 48), P("fsdp", None), env.mesh)
+    assert spec == P("fsdp", "data")
+    # Nothing to overlay -> the target spec unchanged.
+    assert rd.restore_layout_spec((64,), P("fsdp"), env.mesh) == P("fsdp")
+    # Indivisible dims shed axes instead of breaking the layout.
+    assert rd.restore_layout_spec((7, 5), P(), env.mesh) == P(None, None)
+    # The resulting transition is a clean DROP program.
+    plan = rd.compile_leaf_plan(
+        (64, 48), jnp.float32,
+        NamedSharding(env.mesh, spec),
+        NamedSharding(env.mesh, P("fsdp", None)),
+    )
+    assert plan.kind == "collective"
+    assert not plan.transition.moves and not plan.transition.adds
+    assert plan.transition.drops
+
+
+# -------------------------------------------------- roundtrip identity grid
+
+MESH_PAIRS = {
+    "one_to_n": (
+        lambda: (_mesh(devices=[jax.devices()[0]], data=1), P()),
+        lambda: (_mesh(data=1, model=8), P("model", None)),
+    ),
+    "n_to_m_shrink": (
+        lambda: (_mesh(devices=jax.devices()[:4], data=1, model=4),
+                 P(None, "model")),
+        lambda: (_mesh(devices=jax.devices()[:2], data=1, model=2),
+                 P(None, "model")),
+    ),
+    "n_to_m_grow": (
+        lambda: (_mesh(devices=jax.devices()[:2], data=1, model=2),
+                 P("model", None)),
+        lambda: (_mesh(data=1, model=8), P("model", None)),
+    ),
+    "fsdp_model_to_model_only": (
+        lambda: (_mesh(data=1, fsdp=4, model=2), P("fsdp", "model")),
+        lambda: (_mesh(devices=jax.devices()[:2], data=1, model=2),
+                 P(None, "model")),
+    ),
+    "mpmd_stage_to_merged": (
+        # A stage-local tree on its pipe-slice submesh re-spread onto
+        # the full merged mesh (the ISSUE 14 stage layout -> plain
+        # stack placement seam).
+        lambda: (_mesh(devices=jax.devices()[:2], data=2), P("data", None)),
+        lambda: (_mesh(data=2, fsdp=4), P(("data", "fsdp"), None)),
+    ),
+}
+
+DTYPES = {
+    "f32": np.float32,
+    "bf16": jnp.bfloat16,
+    "int8": np.int8,
+    "fp8_e4m3": jnp.float8_e4m3fn,
+}
+
+
+@pytest.mark.parametrize("dtype_name", list(DTYPES))
+@pytest.mark.parametrize("pair", list(MESH_PAIRS))
+def test_roundtrip_identity_grid(pair, dtype_name):
+    """Bit-exact there AND back across every mesh-pair shape the seams
+    exercise, for every cache dtype class — int8/fp8 cover the
+    quantized-KV scale/payload leaves (PR 6 format vocabulary)."""
+    src_fn, dst_fn = MESH_PAIRS[pair]
+    src_env, src_spec = src_fn()
+    dst_env, dst_spec = dst_fn()
+    dtype = DTYPES[dtype_name]
+    rng = np.random.default_rng(7)
+    x_np = rng.integers(-100, 100, size=(64, 32)).astype(np.float32)
+    x_np = np.asarray(jnp.asarray(x_np).astype(dtype))
+    x = jax.device_put(x_np, NamedSharding(src_env.mesh, src_spec))
+    ref = _bits(x)
+
+    out, plan = rd.redistribute_tree(
+        {"w": x}, {"w": NamedSharding(dst_env.mesh, dst_spec)}
+    )
+    assert _bits(out["w"]) == ref
+    assert plan.bytes_moved == plan.bytes_lower_bound
+    assert plan.executed_scratch_bytes <= max(
+        plan.peak_scratch_bytes, 1
+    )
+    # And back: the roundtrip is the identity.
+    back, _ = rd.redistribute_tree(
+        out, {"w": jax.device_put(x_np, NamedSharding(
+            src_env.mesh, src_spec)).sharding}
+    )
+    assert _bits(back["w"]) == ref
+
+
+def test_collective_executor_matches_naive_reference(monkeypatch):
+    """Every same-mesh collective program class == the replicated-staging
+    oracle (gather-everything-then-slice), bit for bit — the correctness
+    half of the mutation gate (the lint half lives in
+    tests/test_graft_lint.py)."""
+    env = _mesh(data=2, fsdp=2, model=2)
+    rng = np.random.default_rng(3)
+    x_np = rng.standard_normal((32, 16, 8)).astype(np.float32)
+    cases = [
+        (P("fsdp", None, None), P(None, "fsdp", None)),   # move
+        (P(("data", "fsdp"), None, None), P(None, None, None)),  # drop
+        (P(None, None, None), P("model", None, None)),    # add
+        (P("fsdp", "data", None), P("fsdp", None, "model")),  # drop+add
+    ]
+    for src_spec, dst_spec in cases:
+        x = jax.device_put(x_np, NamedSharding(env.mesh, src_spec))
+        plan = rd.compile_leaf_plan(
+            x.shape, x.dtype, x.sharding,
+            NamedSharding(env.mesh, dst_spec),
+        )
+        assert plan.kind == "collective", (str(src_spec), str(dst_spec))
+        out = rd.execute_leaf(plan, x, donate=False)
+        monkeypatch.setattr(rd_exec, "_NAIVE_GATHER_SCATTER", True)
+        naive = rd.execute_leaf(plan, x, donate=False)
+        monkeypatch.setattr(rd_exec, "_NAIVE_GATHER_SCATTER", False)
+        assert _bits(out) == _bits(naive) == x_np.tobytes()
+
+
+def test_collective_program_cache_keys_on_mesh_shape():
+    """Regression (review find): two meshes with the SAME device ids but
+    different axis shapes lower identical spec strings to different
+    placements — the program cache must not hand the second mesh the
+    first mesh's jitted program."""
+    x_np = np.arange(64 * 32, dtype=np.float32).reshape(64, 32)
+    for kw in (dict(data=2, model=4), dict(data=4, model=2)):
+        env = _mesh(**kw)
+        x = jax.device_put(
+            x_np, NamedSharding(env.mesh, P("model", None))
+        )
+        dst = NamedSharding(env.mesh, P(None, "model"))
+        plan = rd.compile_leaf_plan(x.shape, x.dtype, x.sharding, dst)
+        assert plan.kind == "collective"
+        out = rd.execute_leaf(plan, x, donate=False)
+        ref = jax.device_put(x_np, dst)
+        for a, b in zip(
+            sorted(out.addressable_shards, key=lambda s: s.device.id),
+            sorted(ref.addressable_shards, key=lambda s: s.device.id),
+        ):
+            assert a.index == b.index, (kw, a.device, a.index, b.index)
+            np.testing.assert_array_equal(
+                np.asarray(a.data), np.asarray(b.data)
+            )
+
+
+def test_executor_donates_source():
+    env = _mesh(data=2, fsdp=4)
+    serve = _mesh(devices=jax.devices()[:2], data=1, model=2)
+    x_np = np.arange(64 * 32, dtype=np.float32).reshape(64, 32)
+    # Chunked (cross-mesh) donation: source deleted once the move lands.
+    x = jax.device_put(x_np, NamedSharding(env.mesh, P("fsdp", None)))
+    out, _ = rd.redistribute_tree(
+        {"w": x}, {"w": NamedSharding(serve.mesh, P(None, "model"))},
+        donate=True,
+    )
+    assert x.is_deleted()
+    assert _bits(out["w"]) == x_np.tobytes()
+    # Collective donation rides donate_argnums inside the program.
+    y = jax.device_put(x_np, NamedSharding(env.mesh, P("fsdp", None)))
+    out2, _ = rd.redistribute_tree(
+        {"w": y}, {"w": NamedSharding(env.mesh, P(None, "fsdp"))},
+        donate=True,
+    )
+    assert y.is_deleted()
+    assert _bits(out2["w"]) == x_np.tobytes()
+
+
+# ------------------------------------------------------ seam 1: restore
+
+
+def ckpt_cfg(tmp_path, extra=()):
+    return apply_overrides(
+        get_config("mnist_mlp"),
+        [
+            "trainer.total_steps=6",
+            "trainer.log_every=3",
+            "trainer.eval_every=0",
+            "data.global_batch_size=64",
+            "model.hidden_sizes=64,32",
+            "precision.policy=fp32",
+            "checkpoint.enabled=true",
+            "checkpoint.save_every=2",
+            "checkpoint.async_save=false",
+            f"workdir={tmp_path}",
+        ]
+        + list(extra),
+    )
+
+
+def _gpt_trainer_cfg(tmp_path, extra=()):
+    return apply_overrides(
+        get_config("gpt2_medium_zero1"),
+        [
+            "model.vocab_size=128", "model.num_layers=2",
+            "model.num_heads=4", "model.hidden_dim=64", "model.seq_len=32",
+            "data.vocab_size=128", "data.seq_len=32",
+            "data.global_batch_size=16",
+            "trainer.total_steps=2", "trainer.log_every=10",
+            "trainer.eval_every=0", "trainer.grad_accum=1",
+            "precision.policy=fp32",
+            "parallel.param_sharding=fsdp", "parallel.fsdp_min_size=16",
+            "checkpoint.enabled=true", "checkpoint.save_every=2",
+            "checkpoint.async_save=false",
+            f"workdir={tmp_path}",
+        ]
+        + list(extra),
+    )
+
+
+def _assert_state_bitexact(a, b):
+    flat_a = jax.tree_util.tree_leaves(jax.device_get(a))
+    flat_b = jax.tree_util.tree_leaves(jax.device_get(b))
+    assert len(flat_a) == len(flat_b)
+    for x, y in zip(flat_a, flat_b):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_restore_redistributed_fsdp_model_onto_smaller_mesh(tmp_path):
+    """The acceptance headline, seam 1: an fsdp×model checkpoint
+    restores onto a DIFFERENT-SIZE mesh through the redistribution
+    service bit-identically to the direct Orbax resharding read — and
+    the executed plan's scratch stays under the full-tree staging the
+    direct replicated path would need."""
+    cfg = _gpt_trainer_cfg(tmp_path, ["mesh.fsdp=4", "mesh.model=2"])
+    t = Trainer(cfg, mesh_env=build_mesh(cfg.mesh))
+    t.fit()
+    t.checkpointer.close()
+
+    cfg_b = _gpt_trainer_cfg(
+        tmp_path, ["mesh.data=1", "mesh.fsdp=2", "mesh.model=2"]
+    )
+    env_b = build_mesh(cfg_b.mesh, devices=jax.devices()[:4])
+    ref_trainer = Trainer(cfg_b, mesh_env=env_b)
+    ref = ref_trainer.checkpointer.restore_or_init(ref_trainer)
+    ref_trainer.checkpointer.close()
+
+    cfg_r = _gpt_trainer_cfg(
+        tmp_path,
+        ["mesh.data=1", "mesh.fsdp=2", "mesh.model=2",
+         "checkpoint.restore_redistribute=true"],
+    )
+    t_r = Trainer(cfg_r, mesh_env=build_mesh(cfg_r.mesh,
+                                             devices=jax.devices()[:4]))
+    restored = t_r.checkpointer.restore_or_init(t_r)
+    plan = t_r.checkpointer.last_restore_plan
+    assert plan is not None
+    _assert_state_bitexact(restored.params, ref.params)
+    _assert_state_bitexact(restored.opt_state, ref.opt_state)
+    # No replicated staging: every leaf's transient stays under the
+    # whole-leaf copy a naive gather would make on every device (leaves
+    # whose TARGET is replication are the allowed exception — the full
+    # copy is the destination, not staging).
+    from jax.sharding import PartitionSpec as PS
+
+    for leaf in plan.leaves:
+        tgt = getattr(leaf.dst_sharding, "spec", PS())
+        if any(e is not None for e in tuple(tgt)):
+            assert leaf.peak_scratch_bytes < max(leaf.leaf_bytes, 1), (
+                leaf.to_dict()
+            )
+    # Placement landed in the NEW trainer's shardings: it can step.
+    assert int(jax.device_get(restored.step)) == 2
+    t_r.checkpointer.close()
+
+
+@pytest.mark.chaos
+def test_restore_redistributed_reformed_mesh_falls_back_past_torn(tmp_path):
+    """The chaos row (the PR 9 torn-write shape, on a reformed mesh):
+    a torn third save is skipped, and the redistribution restore on a
+    4-device world lands on the last committed step with values
+    bit-identical to the direct restore of that step."""
+    from frl_distributed_ml_scaffold_tpu import faults
+    from frl_distributed_ml_scaffold_tpu.faults import FaultPlan
+
+    cfg = ckpt_cfg(tmp_path, ["mesh.data=8"])
+    with faults.active(
+        FaultPlan([dict(site="checkpoint.torn_write", at=3)])
+    ):
+        t = Trainer(cfg, mesh_env=build_mesh(cfg.mesh))
+        t.fit()
+        t.checkpointer.close()
+
+    cfg4 = ckpt_cfg(
+        tmp_path,
+        ["mesh.data=4", "checkpoint.restore_redistribute=true"],
+    )
+    env4 = build_mesh(cfg4.mesh, devices=jax.devices()[:4])
+    t4 = Trainer(cfg4, mesh_env=env4)
+    ck = t4.checkpointer
+    assert ck.uncommitted_steps() == [6]
+    restored = ck.restore_or_init(t4)
+    assert int(jax.device_get(restored.step)) == 4
+    assert ck.last_restore_plan is not None
+
+    cfg_ref = ckpt_cfg(tmp_path, ["mesh.data=4"])
+    t_ref = Trainer(cfg_ref, mesh_env=env4)
+    ref = t_ref.checkpointer.restore_or_init(t_ref)
+    _assert_state_bitexact(restored.params, ref.params)
+    t_ref.checkpointer.close()
+    t4.checkpointer.close()
+
+
+# ------------------------------------------------- seam 2: train→serve
+
+
+def test_train_to_serve_bit_identical_and_bounded(gpt):
+    """Seam 2: fsdp×model-sharded params reshard onto the serving TP
+    mesh bit-identically to the replicated-staging reference, with every
+    sharded leaf's transient under the full-leaf copy, and the placed
+    params serve token-identically."""
+    model, params, tokens = gpt
+    train_env = _mesh(data=1, fsdp=4, model=2)
+    specs = param_specs(
+        params,
+        ParallelConfig(param_sharding="fsdp", fsdp_min_size=16),
+        train_env.mesh,
+        gpt_tp_rules(),
+    )
+    train_params = jax.tree.map(
+        lambda p, sh: jax.device_put(p, sh),
+        params,
+        shardings_from_specs(specs, train_env.mesh),
+    )
+    serve_env = _mesh(devices=jax.devices()[:2], data=1, model=2)
+    placed, plan = rd.train_to_serve(train_params, serve_env, gpt_tp_rules())
+
+    # Bit-identity vs the replicated-staging reference (device_get the
+    # whole tree, device_put per serving spec).
+    host = jax.device_get(params)
+    for (pa, a), (pb, b) in zip(
+        jax.tree_util.tree_leaves_with_path(placed),
+        jax.tree_util.tree_leaves_with_path(host),
+    ):
+        assert _bits(a) == np.asarray(b).tobytes(), pa
+    assert plan.bytes_moved == plan.bytes_lower_bound
+    for leaf in plan.leaves:
+        tgt = tuple(getattr(leaf.dst_sharding, "spec", P()))
+        if any(e is not None for e in tgt):
+            assert leaf.peak_scratch_bytes < max(leaf.leaf_bytes, 1)
+
+    # The placed tree SERVES: engine output == replicated generate().
+    prompt = np.asarray(tokens[0], np.int32)
+    ref = generate(
+        model, params, jnp.asarray(prompt)[None], max_new_tokens=4,
+        temperature=0.0,
+    )
+    with mesh_context(serve_env):
+        eng = ServingEngine(
+            model, placed, num_slots=2, temperature=0.0, kv_block_size=8
+        )
+        rid = eng.submit(prompt, 4)
+        done = {c.id: c for c in eng.run()}[rid]
+        eng.close()
+    np.testing.assert_array_equal(done.tokens, np.asarray(ref)[0])
+
+
+def test_shard_params_for_serving_routes_sharded_trees(gpt, monkeypatch):
+    """The adoption pin: a device-resident sharded tree goes through
+    redistribute.train_to_serve (not a host round-trip); host trees keep
+    the direct device_put path."""
+    model, params, _ = gpt
+    train_env = _mesh(data=1, fsdp=4, model=2)
+    sharded = jax.tree.map(
+        lambda p: jax.device_put(
+            p, NamedSharding(train_env.mesh, P())
+        ),
+        params,
+    )
+    calls = []
+    real = rd.train_to_serve
+
+    def spy(*a, **kw):
+        calls.append(1)
+        return real(*a, **kw)
+
+    import frl_distributed_ml_scaffold_tpu.redistribute as rmod
+
+    monkeypatch.setattr(rmod, "train_to_serve", spy)
+    serve_env = _mesh(devices=jax.devices()[:2], data=1, model=2)
+    with mesh_context(serve_env):
+        placed = shard_params_for_serving(sharded, serve_env, gpt_tp_rules())
+    assert calls, "sharded tree did not route through the service"
+    _assert_state_bitexact(placed, params)
+    # Host trees: unchanged direct path.
+    calls.clear()
+    with mesh_context(serve_env):
+        placed2 = shard_params_for_serving(
+            jax.device_get(params), serve_env, gpt_tp_rules()
+        )
+    assert not calls
+    _assert_state_bitexact(placed2, params)
+
+
+def test_build_engine_rules_places_and_serves(gpt):
+    model, params, tokens = gpt
+    prompt = np.asarray(tokens[1], np.int32)
+    ref = generate(
+        model, params, jnp.asarray(prompt)[None], max_new_tokens=4,
+        temperature=0.0,
+    )
+    serve_env = _mesh(data=1, model=8)
+    from frl_distributed_ml_scaffold_tpu.config.schema import ServingConfig
+
+    with mesh_context(serve_env):
+        eng = build_engine(
+            model, params,
+            serving=ServingConfig(kv_block_size=8),
+            rules=gpt_tp_rules(), num_slots=2, temperature=0.0,
+        )
+        # Placement actually happened: at least one leaf is
+        # model-sharded per the TP rules.
+        leaves = jax.tree_util.tree_leaves_with_path(eng.params)
+        assert any(
+            "model" in str(getattr(l.sharding, "spec", ""))
+            for _, l in leaves
+        )
+        rid = eng.submit(prompt, 4)
+        done = {c.id: c for c in eng.run()}[rid]
+        eng.close()
+    np.testing.assert_array_equal(done.tokens, np.asarray(ref)[0])
+
+
+# ------------------------------------------------ seam 3: respread_pool
+
+
+@pytest.mark.serving
+def test_respread_pool_inflight_token_identity(gpt):
+    """Seam 3: a live model-axis change mid-decode — grow 2→4 and a
+    fresh engine's shrink 4→2 — keeps every in-flight request
+    token-identical to an uninterrupted replicated run, parks/resumes
+    through the PR 12 machinery, and prices the move (bytes_moved > 0,
+    counted on the telemetry counters)."""
+    model, params, tokens = gpt
+    prompts = [np.asarray(tokens[0], np.int32),
+               np.asarray(tokens[1], np.int32)]
+    ref_eng = ServingEngine(
+        model, params, num_slots=2, temperature=0.0, kv_block_size=8
+    )
+    rids = [ref_eng.submit(p, 8) for p in prompts]
+    ref = {c.id: c for c in ref_eng.run()}
+    ref_eng.close()
+
+    env2 = _mesh(devices=jax.devices()[:2], data=1, model=2)
+    with mesh_context(env2):
+        sp = shard_params_for_serving(params, env2, gpt_tp_rules())
+        eng = ServingEngine(
+            model, sp, num_slots=2, temperature=0.0, kv_block_size=8
+        )
+        ids = [eng.submit(p, 8) for p in prompts]
+        eng.step()
+        eng.step()
+    env4 = _mesh(devices=jax.devices()[:4], data=1, model=4)
+    plans = eng.respread_pool(env4)
+    assert eng.stats["parked"] == 2 and eng.stats["resumed"] == 2
+    assert plans["cache"].bytes_moved > 0
+    assert (
+        plans["cache"].executed_scratch_bytes
+        <= plans["cache"].peak_scratch_bytes
+    )
+    snap = eng.telemetry.snapshot()
+    assert snap["serve_pool_respread_total"] == 1
+    assert snap["serve_pool_respread_bytes_total"] > 0
+    done = {c.id: c for c in eng.run()}
+    eng.close()
+    for rid, want in zip(ids, rids):
+        np.testing.assert_array_equal(done[rid].tokens, ref[want].tokens)
+
+    # Shrink: 4 → 2 via the int convenience form.
+    env4b = _mesh(devices=jax.devices()[:4], data=1, model=4)
+    with mesh_context(env4b):
+        sp4 = shard_params_for_serving(params, env4b, gpt_tp_rules())
+        eng2 = ServingEngine(
+            model, sp4, num_slots=2, temperature=0.0, kv_block_size=8
+        )
+        ids2 = [eng2.submit(p, 8) for p in prompts]
+        eng2.step()
+    eng2.respread_pool(2)
+    done2 = {c.id: c for c in eng2.run()}
+    eng2.close()
+    for rid, want in zip(ids2, rids):
+        np.testing.assert_array_equal(done2[rid].tokens, ref[want].tokens)
+
+
+@pytest.mark.fast
+def test_respread_refuses_bucketed_and_indivisible(gpt):
+    model, params, _ = gpt
+    eng = ServingEngine(model, params, num_slots=2, temperature=0.0)
+    with pytest.raises(ValueError, match="paged-engine"):
+        eng.respread_pool(2)
+    eng.close()
+    eng2 = ServingEngine(
+        model, params, num_slots=2, temperature=0.0, kv_block_size=8
+    )
+    with pytest.raises(ValueError, match="num_heads"):
+        eng2.respread_pool(
+            _mesh(data=1, model=8)
+        )  # 8 does not divide 4 heads
+    eng2.close()
+
+
+# ------------------------------------------------------------ quantized
+
+
+def test_respread_quantized_pool_scale_leaves():
+    """The int8 pool's 1-byte payloads AND bf16 scale pools re-spread
+    bit-exactly (the dtypes row of the acceptance grid, on the real
+    engine tree)."""
+    model = GPT(GPTConfig(**dict(TINY, kv_cache_quant="int8")), FP32)
+    tokens = jax.random.randint(jax.random.key(2), (2, 8), 0, 64)
+    params = jit_init(model, tokens, train=False)["params"]
+    prompt = np.asarray(tokens[0], np.int32)
+    ref_eng = ServingEngine(
+        model, params, num_slots=2, temperature=0.0, kv_block_size=8
+    )
+    rid_ref = ref_eng.submit(prompt, 6)
+    ref = {c.id: c for c in ref_eng.run()}[rid_ref]
+    ref_eng.close()
+
+    env2 = _mesh(devices=jax.devices()[:2], data=1, model=2)
+    with mesh_context(env2):
+        sp = shard_params_for_serving(params, env2, gpt_tp_rules())
+        eng = ServingEngine(
+            model, sp, num_slots=2, temperature=0.0, kv_block_size=8
+        )
+        rid = eng.submit(prompt, 6)
+        eng.step()
+    plans = eng.respread_pool(4)
+    # Scale pools rode the plan next to the 1-byte payloads.
+    paths = [l.path for l in plans["cache"].leaves]
+    assert any("key_pool_scale" in p for p in paths), paths
+    done = {c.id: c for c in eng.run()}[rid]
+    eng.close()
+    np.testing.assert_array_equal(done.tokens, ref.tokens)

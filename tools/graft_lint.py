@@ -105,6 +105,10 @@ def main(argv: list[str] | None = None) -> int:
         help="skip the serving decode-step lint",
     )
     ap.add_argument(
+        "--no-reshard", action="store_true",
+        help="skip the redistribution executor (reshard:*) lint",
+    )
+    ap.add_argument(
         "--no-hygiene", action="store_true",
         help="skip the AST hygiene lint",
     )
@@ -152,6 +156,7 @@ def main(argv: list[str] | None = None) -> int:
     reports = lint_all(
         recipes=None if args.all_recipes else args.recipe,
         serving=not args.no_serving,
+        reshard=not args.no_reshard,
         hygiene=not args.no_hygiene,
         robustness=not args.no_robustness,
         workdir=args.workdir,

@@ -82,6 +82,15 @@ HANDOFF_PROGRAM = "serving:handoff"
 MPMD_RECIPE = "gpt2_pipeline_mpmd"
 MPMD_STAGE_PREFIX = "pipeline:stage"
 
+#: Redistribution-service migration rows (ISSUE 15): one per lintable
+#: same-mesh executor program class (reshard:* — census bytes ARE the
+#: wire cost) plus the tree-level train→serve handoff plan over the
+#: tiny-GPT twin (chunked cross-mesh — priced by the plan compiler's
+#: cost model: bytes_moved vs the shard-delta lower bound, peak
+#: scratch). Analytic-only; the measured arm is queued as BACKLOG R18-1
+#: (perf_sweep reshard_train_to_serve).
+REDISTRIBUTE_PREFIX = "redistribute:"
+
 #: Analytic row fields --check compares EXACTLY. Everything else in a row
 #: (intensity, roofline, measured) is either derived from these or
 #: measured wall time. ``schedule`` makes the rows per-SCHEDULE (ISSUE
@@ -286,6 +295,76 @@ def analytic_serving_row(
         row["positions_per_invocation"] = positions
         row["flops_per_position"] = flops // positions
     return row
+
+
+def analytic_redistribute_rows() -> dict:
+    """Migration rows for the redistribution service (ISSUE 15). The
+    executor program rows share graft-lint's ``build_reshard_program``
+    artifacts (census bytes = wire cost; ``bytes_moved`` pinned equal to
+    the shard-delta ``bytes_lower_bound`` — the 2112.01075 minimality
+    claim as a gated number); the ``train_to_serve`` row compiles the
+    tiny-GPT fsdp×model → serving-TP tree plan abstractly (nothing
+    runs)."""
+    from frl_distributed_ml_scaffold_tpu.analysis.collectives import (
+        census_summary,
+        collective_census,
+    )
+    from frl_distributed_ml_scaffold_tpu.analysis.runner import (
+        RESHARD_PROGRAMS,
+        build_reshard_program,
+    )
+    from frl_distributed_ml_scaffold_tpu.utils.flops import jaxpr_flops
+
+    rows: dict[str, dict] = {}
+    sched = {"declared": "redistribute", "short": "reshard"}
+    for name in sorted(RESHARD_PROGRAMS):
+        plan, jaxpr, _lowered = build_reshard_program(name)
+        census = collective_census(jaxpr)
+        comm = sum(r.total_bytes for r in census)
+        flops = jaxpr_flops(jaxpr)
+        chips = plan.dst_sharding.mesh.size
+        rows[REDISTRIBUTE_PREFIX + name.split(":", 1)[1]] = {
+            "flops_per_step": flops,
+            "collective_bytes_per_step": comm,
+            "collectives": {
+                prim: agg
+                for prim, agg in sorted(census_summary(census).items())
+            },
+            "params_bytes": plan.leaf_bytes,
+            "chips": chips,
+            "schedule": sched,
+            "bytes_moved": plan.bytes_moved,
+            "bytes_lower_bound": plan.bytes_lower_bound,
+            "peak_scratch_bytes": plan.peak_scratch_bytes,
+            "intensity_flops_per_byte": round(flops / max(comm, 1), 3),
+            "roofline": _roofline(flops, comm, chips),
+        }
+
+    # The train→serve handoff, tree-level: the shared tiny-GPT abstract
+    # twin (analysis.runner.build_train_to_serve_plan — the same plan
+    # tools/reshard_plan.py prices, so row and dry-run cannot drift).
+    from frl_distributed_ml_scaffold_tpu.analysis.runner import (
+        build_train_to_serve_plan,
+    )
+
+    plan, train_env, _serve_env = build_train_to_serve_plan()
+    rows[REDISTRIBUTE_PREFIX + "train_to_serve"] = {
+        "flops_per_step": 0,
+        "collective_bytes_per_step": plan.bytes_moved,
+        "collectives": {},
+        "params_bytes": plan.total_bytes,
+        "chips": train_env.mesh.size,
+        "schedule": {"declared": "redistribute", "short": "t2s"},
+        "bytes_moved": plan.bytes_moved,
+        "bytes_lower_bound": plan.bytes_lower_bound,
+        "peak_scratch_bytes": plan.peak_scratch_bytes,
+        "plan_kinds": sorted(
+            {leaf.kind for leaf in plan.leaves}
+        ),
+        "intensity_flops_per_byte": 0.0,
+        "roofline": _roofline(0, plan.bytes_moved, train_env.mesh.size),
+    }
+    return rows
 
 
 def analytic_stage_rows(workdir: str = "/tmp/perf_ledger") -> dict:
@@ -500,6 +579,10 @@ def build_ledger(
     print(f"perf_ledger: tracing {MPMD_STAGE_PREFIX}* "
           f"({MPMD_RECIPE})", flush=True)
     rows.update(analytic_stage_rows(workdir))
+    # Redistribution-service migration rows (ISSUE 15): analytic-only —
+    # the measured train→serve arm is queued as BACKLOG R18-1.
+    print(f"perf_ledger: tracing {REDISTRIBUTE_PREFIX}*", flush=True)
+    rows.update(analytic_redistribute_rows())
     from frl_distributed_ml_scaffold_tpu.utils.flops import (
         peak_flops_per_chip,
     )
@@ -524,8 +607,27 @@ def check_ledger(
     measured step time within a factor of ``tol`` when re-measured."""
     problems: list[str] = []
     stage_rows: dict | None = None  # rebuilt once on first pipeline: row
+    redist_rows: dict | None = None  # rebuilt once on first redistribute:
     for program, base in sorted(baseline.get("rows", {}).items()):
-        if program.startswith(MPMD_STAGE_PREFIX):
+        if program.startswith(REDISTRIBUTE_PREFIX):
+            if redist_rows is None:
+                try:
+                    redist_rows = analytic_redistribute_rows()
+                except Exception as e:
+                    problems.append(
+                        f"{program}: redistribute rows no longer compile "
+                        f"({type(e).__name__}: {e})"
+                    )
+                    redist_rows = {}
+            cur = redist_rows.get(program)
+            if cur is None:
+                if redist_rows:
+                    problems.append(
+                        f"{program}: baseline redistribute row no longer "
+                        f"produced (have: {sorted(redist_rows)})"
+                    )
+                continue
+        elif program.startswith(MPMD_STAGE_PREFIX):
             if stage_rows is None:
                 try:
                     stage_rows = analytic_stage_rows(workdir)
@@ -582,7 +684,9 @@ def check_ledger(
         for extra in ("cache_bytes", "splice_table_bytes",
                       "splice_blocks_written", "splice_block_bytes",
                       "bubble_fraction", "peak_live_activations",
-                      "stage_peak_live", "boundary_bytes_per_microbatch"):
+                      "stage_peak_live", "boundary_bytes_per_microbatch",
+                      "bytes_moved", "bytes_lower_bound",
+                      "peak_scratch_bytes", "plan_kinds"):
             if extra in base and base[extra] != cur.get(extra):
                 problems.append(
                     f"{program}: {extra} drifted — baseline "

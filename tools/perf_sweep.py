@@ -525,6 +525,71 @@ def gpt2_pipeline_mpmd():
             )
 
 
+def reshard_train_to_serve():
+    """The train→serve handoff A/B (ISSUE 15, queued as BACKLOG R18-1):
+    redistribute a gpt2 fsdp×model training params tree onto the
+    serving TP layout via the plan executor vs the replicated-staging
+    reference (device_get the full tree, device_put per the serving
+    specs). The sim-gated side pins bit-identity and the scratch budget
+    (tests/test_redistribute.py); this measures the wall-clock and
+    effective GB/s of both paths on real ICI, where the executor's
+    shard-delta transfers should win by roughly the replication factor.
+    Needs >= 4 devices (a real fsdp axis x model=2)."""
+    import jax
+    import numpy as np
+
+    n = jax.device_count()
+    if n < 4:
+        print(json.dumps({
+            "experiment": "reshard_train_to_serve",
+            "skipped": f"needs >=4 devices for fsdp x model (have {n})",
+        }), flush=True)
+        return
+    from frl_distributed_ml_scaffold_tpu import redistribute
+    from frl_distributed_ml_scaffold_tpu.dist.mesh import (
+        MeshConfig, build_mesh,
+    )
+    from frl_distributed_ml_scaffold_tpu.models.gpt import gpt_tp_rules
+    from frl_distributed_ml_scaffold_tpu.parallel.partition import (
+        shard_params_for_serving,
+    )
+
+    trainer, state, _ = build(
+        "gpt2_medium_zero1",
+        [f"mesh.fsdp={n // 2}", "mesh.model=2",
+         "data.global_batch_size=16", "checkpoint.enabled=false"],
+    )
+    serve_env = build_mesh(
+        MeshConfig(data=1, model=2), devices=jax.devices()[:2]
+    )
+    for arm in ("redistribute", "replicated_staging"):
+        t0 = time.perf_counter()
+        if arm == "redistribute":
+            placed, plan = redistribute.train_to_serve(
+                state.params, serve_env, gpt_tp_rules()
+            )
+            moved = plan.bytes_moved
+        else:
+            host = jax.device_get(state.params)  # the staging the
+            # executor exists to avoid — measured as the reference
+            placed = shard_params_for_serving(host, serve_env, gpt_tp_rules())
+            moved = sum(
+                np.prod(l.shape) * l.dtype.itemsize
+                for l in jax.tree.leaves(host)
+            )
+        jax.block_until_ready(placed)
+        dt = time.perf_counter() - t0
+        print(json.dumps({
+            "experiment": "reshard_train_to_serve",
+            "arm": arm,
+            "wall_s": round(dt, 4),
+            "bytes_moved": int(moved),
+            "gbytes_per_s": round(moved / dt / 1e9, 3),
+            "n_chips": n,
+        }), flush=True)
+        del placed
+
+
 def rn50_fused_bn():
     """The priced HBM-ceiling fix, bought (BACKLOG R5-4): the roofline
     pins ~150 ms of the 227 ms headline step in BN-backward HBM traffic
@@ -549,7 +614,8 @@ GROUPS = {f.__name__: f for f in (rn50_bs, rn50_precision, rn50_fwd_only,
                                   rn50_fused_opt, rn50_fused_bn,
                                   moe_dispatch, gpt2_fsdp_overlap,
                                   gpt2_tp_overlap, gpt2_fsdp_tp_overlap,
-                                  gpt2_pipeline_mpmd)}
+                                  gpt2_pipeline_mpmd,
+                                  reshard_train_to_serve)}
 
 if __name__ == "__main__":
     which = sys.argv[1:] or list(GROUPS)
