@@ -1,0 +1,790 @@
+"""Concurrency lint: lock discipline for the host orchestration tier (ISSUE 20).
+
+The host side of this repo is genuinely threaded — the stall watchdog,
+the elastic heartbeat + grow-watch threads, the prefetch executor, the
+native dataloader's worker pool behind ctypes — and the never-hangs
+contract was, until this pass, pinned only by example-based tests.  This
+module turns the lock-discipline review checklist into a whole-package
+AST pass (program ``concurrency:package``, alongside
+``robustness:package``) with three finding kinds:
+
+- ``unguarded-shared-write`` (error) — a class (or module) that owns a
+  lock or touches ``threading.Thread`` writes an attribute under
+  ``with self._lock:`` in at least one method, establishing the lock as
+  that attribute's guard; a *read-modify-write* of the same attribute
+  outside any of its guarding locks is then a lost-update race.  Plain
+  overwrites are deliberately NOT flagged: single-writer handoffs like
+  the stall watchdog's documented lock-free ``_last``/``_beaten``
+  ordering are a legitimate idiom, and they never read-modify-write.
+- ``lock-order-inversion`` (error) — the interprocedural
+  lock-acquisition-order graph (nested ``with`` regions plus call edges
+  resolved through a name-keyed call graph) contains a cycle.  Two
+  threads walking a cycle's edges in opposite orders deadlock.
+- ``blocking-under-lock`` (error/warning) — a call that can block
+  indefinitely or for device-scale time executes while a lock is held:
+  ``block_until_ready``/``device_put`` (device sync under the metrics
+  lock deadlocks the watchdog that samples it), ``subprocess``
+  waits, ``.result()``, thread ``.join()``, ``time.sleep`` (errors);
+  generic ``.wait()`` (warning — condition/event waits are sometimes a
+  deliberate handoff, but holding an unrelated lock across one is
+  almost always wrong).
+
+Lock identity is canonical ``Owner.attr`` (class name or module
+basename), so the ubiquitous attribute name ``_lock`` never aliases
+across classes.  Foreign locks (``self._reg._lock``, ``registry._lock``)
+resolve through ``__init__``/parameter type annotations — that is how
+the real ``FaultPlan._lock -> MetricsRegistry._lock`` nesting edge in
+``faults/plan.py`` is modeled (and verified acyclic) rather than
+skipped.  An unresolvable lock-shaped expression gets an opaque
+per-scope id that cannot alias anything, which keeps the cycle check
+sound (no fabricated edges) at the cost of missing aliased orders.
+
+The runtime twin of this pass is ``faults.instrumented_locks()``
+(``faults/locks.py``), which observes the same properties — acquisition
+order acyclicity, hold times — on live threads during fault drills.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Iterable, Mapping, Optional
+
+from .findings import Finding
+
+__all__ = [
+    "lint_concurrency_source",
+    "lint_concurrency_sources",
+    "lint_concurrency_paths",
+]
+
+PASS = "concurrency"
+
+#: Call names never followed through the interprocedural call graph:
+#: container/string/builtin methods so common that name-keyed resolution
+#: would connect everything to everything.  Deliberately NOT listed:
+#: ``inc``/``observe``/``fire``/``beat`` — those are the package's own
+#: hot cross-lock calls and following them is the whole point.
+_CALL_STOPLIST = frozenset(
+    {
+        "append", "extend", "insert", "pop", "add", "remove", "discard",
+        "clear", "update", "keys", "values", "items", "get", "setdefault",
+        "sort", "reverse", "copy", "deepcopy",
+        "split", "rsplit", "join", "strip", "lstrip", "rstrip",
+        "startswith", "endswith", "format", "replace", "encode", "decode",
+        "lower", "upper", "count", "index", "find",
+        "len", "str", "int", "float", "bool", "list", "dict", "set",
+        "tuple", "frozenset", "sorted", "reversed", "min", "max", "sum",
+        "abs", "round", "range", "enumerate", "zip", "map", "filter",
+        "next", "iter", "isinstance", "issubclass", "hasattr", "getattr",
+        "setattr", "delattr", "id", "repr", "hash", "print", "type",
+        "super", "vars", "callable", "any", "all", "open", "read",
+        "write", "close", "info", "debug", "warning", "error",
+        "exception", "item", "tolist", "group", "match", "search",
+    }
+)
+
+#: ``.join()`` receivers that look like threads/processes; ``", ".join``
+#: and ``os.path.join`` must not trip the blocking rule.
+_THREADISH_RE = re.compile(r"thread|proc|worker|child|watcher", re.I)
+
+_SUBPROCESS_WAITERS = frozenset(
+    {"run", "call", "check_call", "check_output"}
+)
+
+_LOCK_FACTORIES = frozenset(
+    {"threading.Lock", "threading.RLock", "threading.Condition"}
+)
+
+
+def _dotted(node: ast.AST) -> str:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _blocking_class(node: ast.Call) -> Optional[tuple[str, str]]:
+    """Classify a call as (kind, severity) if it can block, else None."""
+    dotted = _dotted(node.func)
+    if isinstance(node.func, ast.Attribute):
+        leaf = node.func.attr
+        recv = _dotted(node.func.value)
+    elif isinstance(node.func, ast.Name):
+        leaf = node.func.id
+        recv = ""
+    else:
+        return None
+    if leaf in ("block_until_ready", "device_put"):
+        return (leaf, "error")
+    root = dotted.split(".", 1)[0] if dotted else ""
+    if root == "subprocess" and leaf in _SUBPROCESS_WAITERS:
+        return (dotted, "error")
+    if leaf == "communicate" and recv:
+        return (f"{recv}.communicate", "error")
+    if leaf == "sleep" and (not recv or recv == "time"):
+        return ("time.sleep", "error")
+    if leaf == "result" and isinstance(node.func, ast.Attribute):
+        return (f"{recv or '<expr>'}.result", "error")
+    if leaf == "join" and recv and _THREADISH_RE.search(recv):
+        return (f"{recv}.join", "error")
+    if leaf == "wait" and isinstance(node.func, ast.Attribute):
+        return (f"{recv or '<expr>'}.wait", "warning")
+    return None
+
+
+# ---------------------------------------------------------------------------
+# phase 1: per-module collection
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _Scope:
+    """A class (or a module pseudo-scope) that can own locks."""
+
+    name: str            # class name, or module basename for module scope
+    filename: str
+    is_class: bool
+    locks: dict[str, int] = dataclasses.field(default_factory=dict)
+    attr_types: dict[str, str] = dataclasses.field(default_factory=dict)
+    threaded: bool = False
+    acquires_any: bool = False  # any method acquires any lock
+
+    def lock_id(self, name: str) -> str:
+        return f"{self.name}.{name}"
+
+
+@dataclasses.dataclass
+class _Fn:
+    """Summary of one function/method after the held-lock walk."""
+
+    qual: str
+    name: str
+    filename: str
+    scope: Optional[_Scope]
+    external_roots: frozenset = frozenset()
+    params: dict = dataclasses.field(default_factory=dict)
+    acquires: set[str] = dataclasses.field(default_factory=set)
+    # every interesting call: (simple, receiver_dotted, lineno)
+    call_entries: list = dataclasses.field(default_factory=list)
+    # blocking calls anywhere in the body: (kind, severity, lineno)
+    blocking_any: list = dataclasses.field(default_factory=list)
+    # nested-with acquisition edges: (held, acquired, lineno)
+    direct_edges: list = dataclasses.field(default_factory=list)
+    # calls made while >=1 lock held: (held_tuple, simple, recv, lineno)
+    calls_under: list = dataclasses.field(default_factory=list)
+    # blocking calls while >=1 lock held: (held, kind, sev, lineno)
+    blocking_under: list = dataclasses.field(default_factory=list)
+    # attribute/global writes: (attr_key, held_frozenset, lineno, is_rmw)
+    writes: list = dataclasses.field(default_factory=list)
+
+
+def _is_lock_ctor(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Call) and _dotted(node.func) in _LOCK_FACTORIES
+    )
+
+
+def _ann_name(ann: Optional[ast.AST]) -> str:
+    """'MetricsRegistry' from an annotation Name/str-Constant/Attribute."""
+    if ann is None:
+        return ""
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        return ann.value.strip().split(".")[-1]
+    d = _dotted(ann)
+    return d.split(".")[-1] if d else ""
+
+
+#: Import roots considered package-internal: relative imports plus
+#: absolute imports of the package itself.  Everything else (stdlib,
+#: numpy, jax) is external — calls through those names are never
+#: resolved into package functions (``subprocess.run`` must not match a
+#: package method that happens to be named ``run``).
+_PKG_ROOT_NAME = __name__.split(".", 1)[0]
+
+
+class _Module:
+    def __init__(self, filename: str, tree: ast.Module):
+        self.filename = filename
+        base = os.path.splitext(os.path.basename(filename))[0]
+        self.mod_scope = _Scope(base, filename, is_class=False)
+        self.external_roots: set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    root = a.name.split(".")[0]
+                    if root != _PKG_ROOT_NAME:
+                        self.external_roots.add(a.asname or root)
+            elif isinstance(node, ast.ImportFrom):
+                if (
+                    node.level == 0
+                    and (node.module or "").split(".")[0] != _PKG_ROOT_NAME
+                ):
+                    for a in node.names:
+                        self.external_roots.add(a.asname or a.name)
+        self.classes: list[tuple[ast.ClassDef, _Scope]] = []
+        self.fns: list[tuple[ast.AST, _Scope]] = []  # walked in phase 2
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.mod_scope.locks[t.id] = node.lineno
+            elif isinstance(node, ast.ClassDef):
+                self.classes.append((node, self._collect_class(node)))
+            elif isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                self.fns.append((node, self.mod_scope))
+        for cnode, scope in self.classes:
+            for item in cnode.body:
+                if isinstance(
+                    item, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    self.fns.append((item, scope))
+
+    def _collect_class(self, cnode: ast.ClassDef) -> _Scope:
+        scope = _Scope(cnode.name, self.filename, is_class=True)
+        for node in ast.walk(cnode):
+            d = _dotted(node) if isinstance(node, ast.Attribute) else ""
+            if d == "threading.Thread":
+                scope.threaded = True
+            if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+                for t in node.targets:
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        scope.locks[t.attr] = node.lineno
+        # self.<attr> = <param> in __init__, param annotated with a class
+        # name: the attribute's type, used to resolve self.attr._lock.
+        for item in cnode.body:
+            if (
+                isinstance(item, ast.FunctionDef)
+                and item.name == "__init__"
+            ):
+                ann = {
+                    a.arg: _ann_name(a.annotation)
+                    for a in (
+                        item.args.posonlyargs
+                        + item.args.args
+                        + item.args.kwonlyargs
+                    )
+                }
+                for node in ast.walk(item):
+                    if (
+                        isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Attribute)
+                        and isinstance(node.targets[0].value, ast.Name)
+                        and node.targets[0].value.id == "self"
+                        and isinstance(node.value, ast.Name)
+                        and ann.get(node.value.id)
+                    ):
+                        scope.attr_types[node.targets[0].attr] = ann[
+                            node.value.id
+                        ]
+        return scope
+
+
+class _Package:
+    """All modules, cross-referenced: lock attr names, class registry."""
+
+    def __init__(self):
+        self.modules: list[_Module] = []
+        self.classes: dict[str, _Scope] = {}
+        self.lock_attr_names: set[str] = set()
+        self.fns: list[_Fn] = []
+        self.unparseable: list[Finding] = []
+
+    def add_source(self, filename: str, source: str) -> None:
+        try:
+            tree = ast.parse(source, filename=filename)
+        except SyntaxError as e:
+            self.unparseable.append(
+                Finding(
+                    PASS,
+                    "warning",
+                    "unparseable",
+                    f"{filename}: not parseable as Python ({e.msg} at "
+                    f"line {e.lineno}); concurrency pass skipped it",
+                    {"file": filename},
+                )
+            )
+            return
+        mod = _Module(filename, tree)
+        self.modules.append(mod)
+        for _, scope in mod.classes:
+            self.classes[scope.name] = scope
+            self.lock_attr_names.update(scope.locks)
+        self.lock_attr_names.update(mod.mod_scope.locks)
+
+
+# ---------------------------------------------------------------------------
+# phase 2: held-lock walk over every function
+# ---------------------------------------------------------------------------
+
+
+def _fn_params(fnnode: ast.AST) -> dict[str, str]:
+    args = fnnode.args
+    return {
+        a.arg: _ann_name(a.annotation)
+        for a in (args.posonlyargs + args.args + args.kwonlyargs)
+        if a.annotation is not None
+    }
+
+
+def _resolve_lock(
+    expr: ast.AST,
+    scope: _Scope,
+    mod_scope: _Scope,
+    pkg: _Package,
+    params: dict[str, str],
+) -> Optional[str]:
+    """Canonical lock id for a with-statement context expression."""
+    d = _dotted(expr)
+    if not d:
+        return None
+    parts = d.split(".")
+    leaf = parts[-1]
+    # self._lock in a class that defines it
+    if len(parts) == 2 and parts[0] == "self" and scope.is_class:
+        if leaf in scope.locks:
+            return scope.lock_id(leaf)
+    # bare module-level lock name
+    if len(parts) == 1 and leaf in mod_scope.locks:
+        return mod_scope.lock_id(leaf)
+    if leaf not in pkg.lock_attr_names:
+        return None  # not lock-shaped at all (with open(...), with mesh:)
+    # self.attr._lock with self.attr's type known from __init__
+    if len(parts) == 3 and parts[0] == "self" and scope.is_class:
+        owner = pkg.classes.get(scope.attr_types.get(parts[1], ""))
+        if owner is not None and leaf in owner.locks:
+            return owner.lock_id(leaf)
+    # param._lock with the parameter annotated
+    if len(parts) == 2:
+        owner = pkg.classes.get(params.get(parts[0], ""))
+        if owner is not None and leaf in owner.locks:
+            return owner.lock_id(leaf)
+    # Lock-shaped but unresolvable: opaque per-scope id.  It participates
+    # in ordering edges but can never alias another scope's lock, so it
+    # cannot fabricate a cycle.
+    return f"{scope.name}:{d}"
+
+
+def _walk_fn(
+    fnnode: ast.AST,
+    scope: _Scope,
+    mod_scope: _Scope,
+    pkg: _Package,
+    external_roots: frozenset,
+    qual_prefix: str = "",
+) -> list[_Fn]:
+    name = fnnode.name
+    qual = f"{qual_prefix or scope.name}.{name}"
+    params = _fn_params(fnnode)
+    fn = _Fn(qual, name, scope.filename, scope, external_roots, params)
+    nested: list[_Fn] = []
+    exempt_writes = scope.is_class and name in ("__init__", "__new__")
+
+    def record_write(target: ast.AST, held: tuple, value: ast.AST,
+                     is_aug: bool, lineno: int) -> None:
+        # unwrap subscript targets: self.x[i] += 1 writes self.x
+        while isinstance(target, ast.Subscript):
+            target = target.value
+        attr_key = None
+        attr_name = None
+        if (
+            isinstance(target, ast.Attribute)
+            and isinstance(target.value, ast.Name)
+            and target.value.id == "self"
+            and scope.is_class
+        ):
+            attr_key = ("class", scope.name, target.attr)
+            attr_name = f"self.{target.attr}"
+        elif isinstance(target, ast.Name) and not scope.is_class:
+            attr_key = ("module", mod_scope.name, target.id)
+            attr_name = target.id
+        if attr_key is None or exempt_writes:
+            return
+        rmw = is_aug
+        if not rmw and value is not None:
+            for sub in ast.walk(value):
+                if _dotted(sub) == _dotted(target) and _dotted(target):
+                    rmw = True
+                    break
+        fn.writes.append(
+            (attr_key, attr_name, frozenset(held), lineno, rmw)
+        )
+
+    def visit(node: ast.AST, held: tuple) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def: runs later (thread target, closure) — walk it
+            # as its own function, with no inherited held locks.
+            nested.extend(
+                _walk_fn(node, scope, mod_scope, pkg, external_roots, qual)
+            )
+            return
+        if isinstance(node, ast.Lambda):
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            new_held = held
+            for item in node.items:
+                visit(item.context_expr, held)
+                lock = _resolve_lock(
+                    item.context_expr, scope, mod_scope, pkg, params
+                )
+                if lock is not None:
+                    fn.acquires.add(lock)
+                    scope.acquires_any = True
+                    for h in new_held:
+                        if h != lock:
+                            fn.direct_edges.append(
+                                (h, lock, node.lineno)
+                            )
+                    new_held = new_held + (lock,)
+            for stmt in node.body:
+                visit(stmt, new_held)
+            return
+        if isinstance(node, ast.Call):
+            simple, recv = "", ""
+            if isinstance(node.func, ast.Name):
+                simple = node.func.id
+            elif isinstance(node.func, ast.Attribute):
+                simple = node.func.attr
+                recv = _dotted(node.func.value)
+            ext = (recv or simple).split(".")[0] in external_roots
+            if simple and simple not in _CALL_STOPLIST and not ext:
+                fn.call_entries.append((simple, recv, node.lineno))
+                if held:
+                    fn.calls_under.append((held, simple, recv, node.lineno))
+            blk = _blocking_class(node)
+            if blk is not None:
+                kind, sev = blk
+                fn.blocking_any.append((kind, sev, node.lineno))
+                if held:
+                    fn.blocking_under.append((held, kind, sev, node.lineno))
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+            return
+        if isinstance(node, ast.AugAssign):
+            record_write(node.target, held, None, True, node.lineno)
+            visit(node.value, held)
+            return
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                for el in t.elts if isinstance(t, ast.Tuple) else [t]:
+                    record_write(el, held, node.value, False, node.lineno)
+            visit(node.value, held)
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for stmt in fnnode.body:
+        visit(stmt, ())
+    return [fn] + nested
+
+
+# ---------------------------------------------------------------------------
+# phase 3: interprocedural closure + findings
+# ---------------------------------------------------------------------------
+
+
+class _Resolver:
+    """Receiver-aware callee lookup: ``self.f()`` resolves in the same
+    class, ``f()`` in the same module, ``self.attr.f()`` / ``param.f()``
+    through ``__init__``/parameter type annotations; only then does it
+    fall back to a global name match.  Calls through externally-imported
+    roots never reach here (filtered at collection time)."""
+
+    def __init__(self, fns: list[_Fn], pkg: _Package):
+        self.by_name: dict[str, list[_Fn]] = {}
+        for f in fns:
+            self.by_name.setdefault(f.name, []).append(f)
+        self.pkg = pkg
+
+    def callees(self, f: _Fn, simple: str, recv: str) -> list[_Fn]:
+        cands = self.by_name.get(simple)
+        if not cands:
+            return []
+        if recv == "self":
+            if f.scope is not None and f.scope.is_class:
+                same = [g for g in cands if g.scope is f.scope]
+                if same:
+                    return same
+        elif recv.startswith("self.") and recv.count(".") == 1:
+            attr = recv.split(".", 1)[1]
+            owner = self.pkg.classes.get(
+                f.scope.attr_types.get(attr, "") if f.scope else ""
+            )
+            if owner is not None:
+                typed = [g for g in cands if g.scope is owner]
+                if typed:
+                    return typed
+        elif recv and "." not in recv:
+            owner = self.pkg.classes.get(f.params.get(recv, ""))
+            if owner is not None:
+                typed = [g for g in cands if g.scope is owner]
+                if typed:
+                    return typed
+        elif not recv:
+            same_file = [g for g in cands if g.filename == f.filename]
+            if same_file:
+                return same_file
+        return cands
+
+
+def _closure_acquires(
+    fns: list[_Fn], resolver: _Resolver
+) -> dict[str, set[str]]:
+    eff = {f.qual: set(f.acquires) for f in fns}
+    changed = True
+    while changed:
+        changed = False
+        for f in fns:
+            cur = eff[f.qual]
+            before = len(cur)
+            for simple, recv, _ in f.call_entries:
+                for g in resolver.callees(f, simple, recv):
+                    cur |= eff[g.qual]
+            if len(cur) != before:
+                changed = True
+    return eff
+
+
+def _closure_blocking(
+    fns: list[_Fn], resolver: _Resolver
+) -> dict[str, dict[str, tuple[str, tuple[str, ...]]]]:
+    """qual -> {kind: (severity, via-chain of callee names)}."""
+    eff: dict[str, dict[str, tuple[str, tuple[str, ...]]]] = {
+        f.qual: {k: (s, ()) for k, s, _ in f.blocking_any} for f in fns
+    }
+    changed = True
+    while changed:
+        changed = False
+        for f in fns:
+            cur = eff[f.qual]
+            for simple, recv, _ in f.call_entries:
+                for g in resolver.callees(f, simple, recv):
+                    for kind, (sev, via) in eff[g.qual].items():
+                        if kind not in cur and len(via) < 6:
+                            cur[kind] = (sev, (simple,) + via)
+                            changed = True
+    return eff
+
+
+def _find_cycles(
+    edges: dict[tuple[str, str], tuple[str, int, str]],
+) -> list[list[str]]:
+    adj: dict[str, list[str]] = {}
+    for a, b in edges:
+        adj.setdefault(a, []).append(b)
+        adj.setdefault(b, [])
+    color: dict[str, int] = {}
+    stack: list[str] = []
+    cycles: list[list[str]] = []
+    seen: set[tuple[str, ...]] = set()
+
+    def dfs(u: str) -> None:
+        color[u] = 1
+        stack.append(u)
+        for v in sorted(adj[u]):
+            if color.get(v, 0) == 0:
+                dfs(v)
+            elif color.get(v) == 1:
+                cyc = stack[stack.index(v):] + [v]
+                ring = cyc[:-1]
+                i = ring.index(min(ring))
+                key = tuple(ring[i:] + ring[:i])
+                if key not in seen:
+                    seen.add(key)
+                    cycles.append(list(key) + [key[0]])
+        stack.pop()
+        color[u] = 2
+
+    for node in sorted(adj):
+        if color.get(node, 0) == 0:
+            dfs(node)
+    return cycles
+
+
+def _analyze(pkg: _Package) -> list[Finding]:
+    findings: list[Finding] = list(pkg.unparseable)
+    fns: list[_Fn] = []
+    for mod in pkg.modules:
+        ext = frozenset(mod.external_roots)
+        for fnnode, scope in mod.fns:
+            fns.extend(_walk_fn(fnnode, scope, mod.mod_scope, pkg, ext))
+    resolver = _Resolver(fns, pkg)
+
+    # --- unguarded-shared-write -------------------------------------
+    # attr_key -> set of locks seen held during a write (the guards)
+    guards: dict[tuple, set[str]] = {}
+    for f in fns:
+        for attr_key, _, held, _, _ in f.writes:
+            if held:
+                guards.setdefault(attr_key, set()).update(held)
+
+    def scope_concurrent(s: _Scope) -> bool:
+        return s.threaded or bool(s.locks) or s.acquires_any
+
+    for f in fns:
+        if f.scope is None or not scope_concurrent(f.scope):
+            continue
+        for attr_key, attr_name, held, lineno, rmw in f.writes:
+            g = guards.get(attr_key)
+            if not rmw or not g or (held & g):
+                continue
+            locks = ", ".join(sorted(g))
+            findings.append(
+                Finding(
+                    PASS,
+                    "error",
+                    "unguarded-shared-write",
+                    f"{f.filename}:{lineno}: {f.qual} read-modify-"
+                    f"writes {attr_name} without holding {locks} "
+                    f"(guarded: written under that lock elsewhere in "
+                    f"{attr_key[1]}) — lost-update race",
+                    {
+                        "file": f.filename,
+                        "line": lineno,
+                        "attr": attr_name,
+                        "locks": sorted(g),
+                        "function": f.qual,
+                    },
+                )
+            )
+
+    # --- lock-order graph + cycles ----------------------------------
+    eff_acq = _closure_acquires(fns, resolver)
+    edges: dict[tuple[str, str], tuple[str, int, str]] = {}
+    for f in fns:
+        for a, b, lineno in f.direct_edges:
+            edges.setdefault(
+                (a, b), (f.filename, lineno, f"nested with in {f.qual}")
+            )
+        for held, simple, recv, lineno in f.calls_under:
+            acq: set[str] = set()
+            for g in resolver.callees(f, simple, recv):
+                acq |= eff_acq[g.qual]
+            for h in held:
+                for l in acq:
+                    if h != l:
+                        edges.setdefault(
+                            (h, l),
+                            (
+                                f.filename,
+                                lineno,
+                                f"{f.qual} calls {simple}() under {h}",
+                            ),
+                        )
+    for cyc in _find_cycles(edges):
+        path = " -> ".join(cyc)
+        sites = "; ".join(
+            f"{a}->{b} ({edges[(a, b)][0]}:{edges[(a, b)][1]}, "
+            f"{edges[(a, b)][2]})"
+            for a, b in zip(cyc, cyc[1:])
+            if (a, b) in edges
+        )
+        findings.append(
+            Finding(
+                PASS,
+                "error",
+                "lock-order-inversion",
+                f"lock acquisition order contains a cycle: {path} — two "
+                f"threads taking these in opposite orders deadlock. "
+                f"Edges: {sites}",
+                {"cycle": cyc, "edges": sites},
+            )
+        )
+
+    # --- blocking-under-lock ----------------------------------------
+    eff_blk = _closure_blocking(fns, resolver)
+    emitted: set[tuple] = set()
+    for f in fns:
+        for held, kind, sev, lineno in f.blocking_under:
+            key = (f.filename, lineno, kind)
+            if key in emitted:
+                continue
+            emitted.add(key)
+            findings.append(
+                Finding(
+                    PASS,
+                    sev,
+                    "blocking-under-lock",
+                    f"{f.filename}:{lineno}: {f.qual} calls {kind} "
+                    f"while holding {held[-1]} — the lock is "
+                    f"unavailable for the full blocking duration",
+                    {
+                        "file": f.filename,
+                        "line": lineno,
+                        "call": kind,
+                        "lock": held[-1],
+                        "function": f.qual,
+                    },
+                )
+            )
+        for held, simple, recv, lineno in f.calls_under:
+            for g in resolver.callees(f, simple, recv):
+                for kind, (sev, via) in eff_blk[g.qual].items():
+                    key = (f.filename, lineno, kind)
+                    if key in emitted:
+                        continue
+                    emitted.add(key)
+                    chain = " -> ".join((simple,) + via)
+                    findings.append(
+                        Finding(
+                            PASS,
+                            sev,
+                            "blocking-under-lock",
+                            f"{f.filename}:{lineno}: {f.qual} holds "
+                            f"{held[-1]} across {chain} which reaches "
+                            f"{kind}",
+                            {
+                                "file": f.filename,
+                                "line": lineno,
+                                "call": kind,
+                                "via": chain,
+                                "lock": held[-1],
+                                "function": f.qual,
+                            },
+                        )
+                    )
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+
+def lint_concurrency_sources(
+    sources: Mapping[str, str],
+) -> list[Finding]:
+    """Run the pass over {filename: source}. Whole-package: lock ids and
+    the call graph resolve across all given modules."""
+    pkg = _Package()
+    for filename, source in sources.items():
+        pkg.add_source(filename, source)
+    return _analyze(pkg)
+
+
+def lint_concurrency_source(
+    source: str, filename: str = "<source>"
+) -> list[Finding]:
+    """Single-module convenience wrapper (synthetic-source tests)."""
+    return lint_concurrency_sources({filename: source})
+
+
+def lint_concurrency_paths(paths: Iterable[str]) -> list[Finding]:
+    sources: dict[str, str] = {}
+    for p in paths:
+        with open(p, "r", encoding="utf-8") as fh:
+            sources[p] = fh.read()
+    return lint_concurrency_sources(sources)

@@ -52,6 +52,8 @@ from frl_distributed_ml_scaffold_tpu.analysis.reshard import (
 )
 
 __all__ = [
+    "assert_lock_order_acyclic",
+    "assert_no_blocking_under_lock",
     "assert_schedule",
     "collective_census",
     "collective_bytes",
@@ -417,6 +419,52 @@ def assert_no_collective_hlo(
         msg,
         f"compiled HLO carries {len(hits)} {op} op(s): "
         + "; ".join(r.line[:100] for r in hits[:3]),
+    )
+
+
+# ------------------------------------------------------ concurrency pins
+
+
+def assert_lock_order_acyclic(
+    recorder: Any, msg: str | None = None
+) -> None:
+    """The runtime lock-order graph a ``faults.instrumented_locks()``
+    recorder observed is acyclic — the live twin of graft-lint's static
+    ``lock-order-inversion`` check (ISSUE 20).  Call it mid-drill or at
+    the end; ``instrumented_locks`` also asserts it at scope exit."""
+    cycle = recorder.find_cycle()
+    assert cycle is None, _fail(
+        msg,
+        f"runtime lock-order cycle {' -> '.join(cycle)} observed "
+        f"(edges: {recorder.order_edges()}) — threads interleaving "
+        "these acquisitions in opposite orders deadlock",
+    )
+
+
+def assert_no_blocking_under_lock(
+    recorder: Any,
+    max_hold_s: float = 2.0,
+    msg: str | None = None,
+) -> None:
+    """No instrumented lock was held longer than ``max_hold_s`` — the
+    runtime signature of ``blocking-under-lock``: a device sync, a
+    subprocess wait, or a sleep under a lock shows up as a pathological
+    hold time long before it shows up as a deadlock.  The default bound
+    is deliberately generous (CI boxes stall); tighten it in perf-tier
+    drills."""
+    offenders = {
+        site: (hold, who)
+        for site, (hold, who) in recorder.max_holds().items()
+        if hold > max_hold_s
+    }
+    assert not offenders, _fail(
+        msg,
+        "locks held past the blocking bound "
+        f"({max_hold_s:g}s): "
+        + "; ".join(
+            f"{site} held {hold:.3f}s by {who or '?'}"
+            for site, (hold, who) in sorted(offenders.items())
+        ),
     )
 
 

@@ -1507,6 +1507,35 @@ def lint_robustness(paths: Iterable[str] | None = None) -> Report:
     return report
 
 
+def lint_concurrency(paths: Iterable[str] | None = None) -> Report:
+    """Lock-discipline lint (ISSUE 20) over the WHOLE package: guarded-
+    attribute inference (``unguarded-shared-write``), the interprocedural
+    lock-acquisition-order graph (``lock-order-inversion``), and blocking
+    calls under held locks (``blocking-under-lock``).  Whole-package like
+    ``robustness:package`` — lock identities and the call graph resolve
+    ACROSS modules (the FaultPlan -> MetricsRegistry nesting edge lives
+    in two files)."""
+    import glob
+    import os
+
+    from frl_distributed_ml_scaffold_tpu.analysis.concurrency import (
+        lint_concurrency_paths,
+    )
+
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if paths is None:
+        paths = sorted(
+            p
+            for p in glob.glob(os.path.join(pkg, "**", "*.py"), recursive=True)
+            if "__pycache__" not in p
+        )
+    paths = list(paths)
+    report = Report(program="concurrency:package")
+    report.extend(lint_concurrency_paths(paths))
+    report.meta["files"] = len(paths)
+    return report
+
+
 def lint_all(
     *,
     recipes: Iterable[str] | None = None,
@@ -1514,6 +1543,7 @@ def lint_all(
     reshard: bool = True,
     hygiene: bool = True,
     robustness: bool = True,
+    concurrency: bool = True,
     workdir: str = "/tmp/graft_lint",
     budget_bytes: int | None = None,
     on_report: Callable[[Report], None] | None = None,
@@ -1573,4 +1603,6 @@ def lint_all(
         emit(lint_hygiene())
     if robustness:
         emit(lint_robustness())
+    if concurrency:
+        emit(lint_concurrency())
     return reports

@@ -52,6 +52,9 @@ _LIB = os.path.join(_NATIVE_DIR, f"libfrl_data.{_host_arch_tag()}.so")
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
 _tried = False
+# Set once the claiming loader has published its result (success or
+# fallback) — racing callers park on this OUTSIDE the lock.
+_done = threading.Event()
 
 
 def _build() -> bool:
@@ -80,76 +83,95 @@ def _build() -> bool:
 
 def _load() -> ctypes.CDLL | None:
     global _lib, _tried
+    # _lock only claims/publishes; the g++ build (subprocess.run, up to
+    # 120 s) and the dlopen run LOCK-FREE.  Holding the module lock
+    # across them was graft-lint concurrency finding blocking-under-lock
+    # (data/native.py _load -> _build -> subprocess.run): every data
+    # thread's first native call would queue behind one compile.
+    # Concurrent builds are already safe without the lock — _build
+    # compiles to a pid-unique temp path and os.replace is atomic.
     with _lock:
-        if _lib is not None or _tried:
-            return _lib
+        claimed = not _tried
         _tried = True
-        if os.environ.get("FRL_TPU_NO_NATIVE"):
-            return None
-        # A lib shipped without its source is simply trusted (no mtime to
-        # compare against) — graceful degradation must not raise.
-        stale = not os.path.exists(_LIB) or (
-            os.path.exists(_SRC)
-            and os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
-        )
-        if stale and not _build():
-            return None
-        try:
-            lib = ctypes.CDLL(_LIB)
-        except OSError as e:
-            get_logger().warning("native data core load failed (%s)", e)
-            return None
-        try:
-            lib.frl_version.restype = ctypes.c_int
-            version = lib.frl_version()
-            if version < 3 and os.path.exists(_SRC):
-                # Stale binary the mtime check missed (checkout ordering,
-                # clock skew) but the source is right here — rebuild once.
-                del lib
-                if _build():
-                    lib = ctypes.CDLL(_LIB)
-                    lib.frl_version.restype = ctypes.c_int
-                    version = lib.frl_version()
-            if version < 3:
-                # A prebuilt .so shipped without source can predate newer
-                # entry points; binding them would raise mid-training.
-                # Degrade, don't crash.
-                get_logger().warning(
-                    "native data core is v%d (< v3, missing gather_windows);"
-                    " using numpy fallback — rebuild from frl_data.cpp",
-                    version,
-                )
-                return None
-            f64 = ctypes.POINTER(ctypes.c_float)
-            i64 = ctypes.POINTER(ctypes.c_int64)
-            u8 = ctypes.POINTER(ctypes.c_uint8)
-            lib.frl_gather_rows.argtypes = [f64, i64, f64, ctypes.c_int64,
-                                            ctypes.c_int64]
-            lib.frl_gather_rows_u8.argtypes = [u8, i64, f64, ctypes.c_int64,
-                                               ctypes.c_int64]
-            lib.frl_augment_batch.argtypes = [
-                f64, f64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
-                ctypes.c_int64, ctypes.c_int64, ctypes.c_uint64, ctypes.c_int,
-                f64, f64,
-            ]
-            i32 = ctypes.POINTER(ctypes.c_int32)
-            u16 = ctypes.POINTER(ctypes.c_uint16)
-            u32 = ctypes.POINTER(ctypes.c_uint32)
-            lib.frl_gather_windows_u16.argtypes = [
-                u16, i64, i32, ctypes.c_int64, ctypes.c_int64
-            ]
-            lib.frl_gather_windows_u32.argtypes = [
-                u32, i64, i32, ctypes.c_int64, ctypes.c_int64
-            ]
-        except AttributeError as e:
+    if not claimed:
+        _done.wait()
+        return _lib
+    try:
+        lib = _load_uncached()
+        with _lock:
+            _lib = lib
+        return lib
+    finally:
+        _done.set()
+
+
+def _load_uncached() -> ctypes.CDLL | None:
+    """Build/bind the library (no caching, no locks held)."""
+    if os.environ.get("FRL_TPU_NO_NATIVE"):
+        return None
+    # A lib shipped without its source is simply trusted (no mtime to
+    # compare against) — graceful degradation must not raise.
+    stale = not os.path.exists(_LIB) or (
+        os.path.exists(_SRC)
+        and os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+    )
+    if stale and not _build():
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB)
+    except OSError as e:
+        get_logger().warning("native data core load failed (%s)", e)
+        return None
+    try:
+        lib.frl_version.restype = ctypes.c_int
+        version = lib.frl_version()
+        if version < 3 and os.path.exists(_SRC):
+            # Stale binary the mtime check missed (checkout ordering,
+            # clock skew) but the source is right here — rebuild once.
+            del lib
+            if _build():
+                lib = ctypes.CDLL(_LIB)
+                lib.frl_version.restype = ctypes.c_int
+                version = lib.frl_version()
+        if version < 3:
+            # A prebuilt .so shipped without source can predate newer
+            # entry points; binding them would raise mid-training.
+            # Degrade, don't crash.
             get_logger().warning(
-                "native data core missing symbols (%s); using numpy fallback",
-                e,
+                "native data core is v%d (< v3, missing gather_windows);"
+                " using numpy fallback — rebuild from frl_data.cpp",
+                version,
             )
             return None
-        _lib = lib
-        get_logger().info("native data core loaded (v%d)", version)
-        return _lib
+        f64 = ctypes.POINTER(ctypes.c_float)
+        i64 = ctypes.POINTER(ctypes.c_int64)
+        u8 = ctypes.POINTER(ctypes.c_uint8)
+        lib.frl_gather_rows.argtypes = [f64, i64, f64, ctypes.c_int64,
+                                        ctypes.c_int64]
+        lib.frl_gather_rows_u8.argtypes = [u8, i64, f64, ctypes.c_int64,
+                                           ctypes.c_int64]
+        lib.frl_augment_batch.argtypes = [
+            f64, f64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_uint64, ctypes.c_int,
+            f64, f64,
+        ]
+        i32 = ctypes.POINTER(ctypes.c_int32)
+        u16 = ctypes.POINTER(ctypes.c_uint16)
+        u32 = ctypes.POINTER(ctypes.c_uint32)
+        lib.frl_gather_windows_u16.argtypes = [
+            u16, i64, i32, ctypes.c_int64, ctypes.c_int64
+        ]
+        lib.frl_gather_windows_u32.argtypes = [
+            u32, i64, i32, ctypes.c_int64, ctypes.c_int64
+        ]
+    except AttributeError as e:
+        get_logger().warning(
+            "native data core missing symbols (%s); using numpy fallback",
+            e,
+        )
+        return None
+    get_logger().info("native data core loaded (v%d)", version)
+    return lib
 
 
 def native_available() -> bool:

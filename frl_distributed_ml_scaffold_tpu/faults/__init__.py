@@ -26,6 +26,10 @@ import os
 import time
 from typing import Any, Iterator, Optional
 
+from frl_distributed_ml_scaffold_tpu.faults.locks import (
+    LockOrderRecorder,
+    instrumented_locks,
+)
 from frl_distributed_ml_scaffold_tpu.faults.plan import (
     KNOWN_SITES,
     FaultPlan,
@@ -37,11 +41,13 @@ __all__ = [
     "KNOWN_SITES",
     "FaultPlan",
     "FaultSpec",
+    "LockOrderRecorder",
     "RetryPolicy",
     "active",
     "current_plan",
     "fire",
     "install",
+    "instrumented_locks",
     "maybe_hang",
     "maybe_raise",
 ]

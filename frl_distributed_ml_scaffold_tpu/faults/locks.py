@@ -1,0 +1,387 @@
+"""Runtime lock-order sentinel: ``faults.instrumented_locks()`` (ISSUE 20).
+
+The static pass (``analysis/concurrency.py``) proves lock discipline
+over the source; this module observes it on LIVE threads.  Inside the
+context, ``threading.Lock``/``RLock``/``Condition`` constructed by
+package code return instrumented wrappers that record, per thread:
+
+- the acquisition-order edges (which lock was held when another was
+  acquired) — the runtime twin of the static lock-order graph;
+- per-creation-site acquisition counts and hold times (max + total).
+
+At scope exit the recorder asserts the observed order graph is ACYCLIC
+— so every chaos/disagg/elastic/redistribute drill that runs under it
+doubles as a deadlock drill: if two threads ever took locks in opposite
+orders during the drill, the test fails even though the interleaving
+happened not to deadlock this time.
+
+Only locks whose creating frame lives inside this package are wrapped
+by default (jax/runtime internals construct locks constantly; their
+hold times during compiles would drown the signal); ``wrap_all=True``
+lifts that for synthetic unit tests.  The recorder's own bookkeeping is
+guarded by an ORIGINAL (unwrapped) lock, so it never records itself.
+
+``analysis.pins.assert_lock_order_acyclic`` /
+``assert_no_blocking_under_lock`` consume the recorder mid-drill.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+import threading
+import time
+from typing import Any, Iterator, Optional
+
+__all__ = ["LockOrderRecorder", "instrumented_locks"]
+
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+_REAL_CONDITION = threading.Condition
+
+#: Directory of the package root (…/frl_distributed_ml_scaffold_tpu).
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_PKG_NAME = os.path.basename(_PKG_DIR)
+
+
+class LockOrderRecorder:
+    """Per-thread acquisition sequences, order edges, and hold times."""
+
+    def __init__(self) -> None:
+        self._meta = _REAL_LOCK()  # guards the dicts below; never wrapped
+        #: (held_site, acquired_site) -> times observed.  Sites are
+        #: per-INSTANCE (creation site + serial): two locks born on the
+        #: same source line are different locks, and flagging a cycle
+        #: across distinct instance pairs would be a false positive
+        #: (hand-over-hand per-item locks are legal).
+        self.edges: dict[tuple[str, str], int] = {}
+        #: site -> acquisitions
+        self.acquired: dict[str, int] = {}
+        #: site -> (max_hold_s, total_hold_s, thread name at max)
+        self.holds: dict[str, tuple[float, float, str]] = {}
+        self._tls = threading.local()
+        self._serials: dict[str, int] = {}
+
+    def instance_site(self, label: str) -> str:
+        """Unique site id for a new lock born at source-site ``label``."""
+        with self._meta:
+            n = self._serials.get(label, 0)
+            self._serials[label] = n + 1
+        return label if n == 0 else f"{label}#{n}"
+
+    # -- wrapper callbacks -------------------------------------------
+    def _held(self) -> list:
+        h = getattr(self._tls, "held", None)
+        if h is None:
+            h = self._tls.held = []
+        return h
+
+    def on_acquired(self, site: str) -> None:
+        held = self._held()
+        t = time.monotonic()
+        with self._meta:
+            self.acquired[site] = self.acquired.get(site, 0) + 1
+            for h_site, _ in held:
+                if h_site != site:
+                    key = (h_site, site)
+                    self.edges[key] = self.edges.get(key, 0) + 1
+        held.append((site, t))
+
+    def on_released(self, site: str) -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] == site:
+                dur = time.monotonic() - held[i][1]
+                del held[i]
+                # NEVER threading.current_thread() here: during thread
+                # bootstrap it can allocate a _DummyThread -> Event ->
+                # patched Lock(), re-entering the recorder.  get_ident
+                # allocates nothing; _active is only read.
+                ident = threading.get_ident()
+                t = threading._active.get(ident)
+                name = t.name if t is not None else f"tid{ident}"
+                with self._meta:
+                    mx, total, who = self.holds.get(site, (0.0, 0.0, ""))
+                    if dur > mx:
+                        mx, who = dur, name
+                    self.holds[site] = (mx, total + dur, who)
+                return
+
+    # -- queries ------------------------------------------------------
+    def order_edges(self) -> dict[tuple[str, str], int]:
+        with self._meta:
+            return dict(self.edges)
+
+    def max_holds(self) -> dict[str, tuple[float, str]]:
+        """site -> (max hold seconds, holding thread's name)."""
+        with self._meta:
+            return {s: (mx, who) for s, (mx, _, who) in self.holds.items()}
+
+    def find_cycle(self) -> Optional[list[str]]:
+        """A lock-order cycle as [site_a, site_b, ..., site_a], or None."""
+        edges = self.order_edges()
+        adj: dict[str, list[str]] = {}
+        for a, b in edges:
+            adj.setdefault(a, []).append(b)
+            adj.setdefault(b, [])
+        color: dict[str, int] = {}
+        stack: list[str] = []
+        out: list[list[str]] = []
+
+        def dfs(u: str) -> None:
+            color[u] = 1
+            stack.append(u)
+            for v in sorted(adj[u]):
+                if out:
+                    break
+                if color.get(v, 0) == 0:
+                    dfs(v)
+                elif color.get(v) == 1:
+                    out.append(stack[stack.index(v):] + [v])
+            stack.pop()
+            color[u] = 2
+
+        for node in sorted(adj):
+            if out:
+                break
+            if color.get(node, 0) == 0:
+                dfs(node)
+        return out[0] if out else None
+
+    def publish(self, registry: Any) -> None:
+        """Counters/gauges for a drill's report: how much locking a
+        fault drill actually exercised, and the worst hold seen."""
+        with self._meta:
+            n_acq = sum(self.acquired.values())
+            n_sites = len(self.acquired)
+            n_edges = len(self.edges)
+            worst = max(
+                (mx for mx, _, _ in self.holds.values()), default=0.0
+            )
+        registry.counter(
+            "lock_acquisitions_total",
+            help="instrumented lock acquisitions during the drill",
+        ).inc(n_acq)
+        registry.gauge(
+            "lock_sites", help="distinct instrumented lock creation sites"
+        ).set(n_sites)
+        registry.gauge(
+            "lock_order_edges",
+            help="observed lock-order edges (held -> acquired)",
+        ).set(n_edges)
+        registry.gauge(
+            "lock_hold_max_seconds",
+            help="longest single lock hold observed",
+        ).set(worst)
+
+
+class _InstrumentedLock:
+    """Wraps a real Lock; reports acquire/release to the recorder."""
+
+    def __init__(self, recorder: LockOrderRecorder, site: str, real: Any):
+        self._recorder = recorder
+        self._site = site
+        self._real = real
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._real.acquire(blocking, timeout)
+        if ok:
+            self._recorder.on_acquired(self._site)
+        return ok
+
+    def release(self) -> None:
+        self._recorder.on_released(self._site)
+        self._real.release()
+
+    def locked(self) -> bool:
+        return self._real.locked()
+
+    def __enter__(self) -> "_InstrumentedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<instrumented {self._real!r} @ {self._site}>"
+
+
+class _InstrumentedRLock(_InstrumentedLock):
+    """Reentrant variant: only the OUTERMOST acquire/release records, so
+    reentry neither double-counts hold time nor self-edges."""
+
+    def __init__(self, recorder: LockOrderRecorder, site: str, real: Any):
+        super().__init__(recorder, site, real)
+        self._depth = threading.local()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._real.acquire(blocking, timeout)
+        if ok:
+            d = getattr(self._depth, "n", 0)
+            self._depth.n = d + 1
+            if d == 0:
+                self._recorder.on_acquired(self._site)
+        return ok
+
+    def release(self) -> None:
+        d = getattr(self._depth, "n", 0)
+        self._depth.n = max(0, d - 1)
+        if d == 1:
+            self._recorder.on_released(self._site)
+        self._real.release()
+
+    # Condition(wrapped_rlock) support: CPython's Condition probes these
+    # and, when absent, falls back to acquire(0)-based ownership checks
+    # that are WRONG for reentrant locks (acquire(0) succeeds for the
+    # owner).  Delegate to the real RLock, keeping the recorder's view
+    # consistent: a full release ends the hold, the restore restarts it.
+    def _release_save(self) -> Any:
+        if getattr(self._depth, "n", 0) > 0:
+            self._recorder.on_released(self._site)
+        self._depth.n = 0
+        return self._real._release_save()
+
+    def _acquire_restore(self, state: Any) -> None:
+        self._real._acquire_restore(state)
+        self._depth.n = 1
+        self._recorder.on_acquired(self._site)
+
+    def _is_owned(self) -> bool:
+        return self._real._is_owned()
+
+
+class _InstrumentedCondition:
+    """A real Condition over a real (R)Lock, with enter/exit/wait
+    reported to the recorder (wait releases, wake re-acquires)."""
+
+    def __init__(
+        self,
+        recorder: LockOrderRecorder,
+        site: str,
+        lock: Any = None,
+    ):
+        if isinstance(lock, _InstrumentedLock):
+            lock = lock._real
+        self._real = _REAL_CONDITION(lock)
+        self._recorder = recorder
+        self._site = site
+
+    def acquire(self, *a: Any, **kw: Any) -> bool:
+        ok = self._real.acquire(*a, **kw)
+        if ok:
+            self._recorder.on_acquired(self._site)
+        return ok
+
+    def release(self) -> None:
+        self._recorder.on_released(self._site)
+        self._real.release()
+
+    def __enter__(self) -> "_InstrumentedCondition":
+        self._real.__enter__()
+        self._recorder.on_acquired(self._site)
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self._recorder.on_released(self._site)
+        self._real.__exit__(*exc)
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        self._recorder.on_released(self._site)
+        try:
+            return self._real.wait(timeout)
+        finally:
+            self._recorder.on_acquired(self._site)
+
+    def wait_for(self, predicate: Any, timeout: Optional[float] = None):
+        self._recorder.on_released(self._site)
+        try:
+            return self._real.wait_for(predicate, timeout)
+        finally:
+            self._recorder.on_acquired(self._site)
+
+    def notify(self, n: int = 1) -> None:
+        self._real.notify(n)
+
+    def notify_all(self) -> None:
+        self._real.notify_all()
+
+
+def _creation_site() -> tuple[str, str]:
+    """(site id, origin) for the frame that called the factory; origin
+    is "threading" (stdlib thread/event internals — never wrapped, they
+    are bootstrap machinery and pure noise), "pkg", or "other"."""
+    f = sys._getframe(2)
+    fn = f.f_code.co_filename
+    if fn == threading.__file__:
+        return "", "threading"
+    in_pkg = _PKG_DIR in os.path.abspath(fn) or (
+        os.sep + _PKG_NAME + os.sep
+    ) in fn
+    try:
+        rel = os.path.relpath(fn, _PKG_DIR)
+    except ValueError:
+        rel = fn
+    return f"{rel}:{f.f_lineno}", "pkg" if in_pkg else "other"
+
+
+@contextlib.contextmanager
+def instrumented_locks(
+    *, registry: Any = None, wrap_all: bool = False
+) -> Iterator[LockOrderRecorder]:
+    """Patch the ``threading`` lock factories package-wide for the scope.
+
+    Yields the :class:`LockOrderRecorder`; at scope exit the factories
+    are restored, telemetry is published to ``registry`` (if given), and
+    a lock-order CYCLE observed at runtime raises ``AssertionError``
+    (only when the body itself did not raise — a drill's own failure is
+    not masked).  ``wrap_all=True`` also wraps locks created outside the
+    package (synthetic unit tests).
+    """
+    rec = LockOrderRecorder()
+
+    def _wrap(origin: str) -> bool:
+        return origin == "pkg" or (wrap_all and origin == "other")
+
+    def lock_factory() -> Any:
+        site, origin = _creation_site()
+        if not _wrap(origin):
+            return _REAL_LOCK()
+        return _InstrumentedLock(rec, rec.instance_site(site), _REAL_LOCK())
+
+    def rlock_factory() -> Any:
+        site, origin = _creation_site()
+        if not _wrap(origin):
+            return _REAL_RLOCK()
+        return _InstrumentedRLock(
+            rec, rec.instance_site(site), _REAL_RLOCK()
+        )
+
+    def condition_factory(lock: Any = None) -> Any:
+        site, origin = _creation_site()
+        if not _wrap(origin):
+            return _REAL_CONDITION(lock)
+        return _InstrumentedCondition(rec, rec.instance_site(site), lock)
+
+    prev = (threading.Lock, threading.RLock, threading.Condition)
+    threading.Lock = lock_factory  # type: ignore[assignment]
+    threading.RLock = rlock_factory  # type: ignore[assignment]
+    threading.Condition = condition_factory  # type: ignore[assignment]
+    ok = False
+    try:
+        yield rec
+        ok = True
+    finally:
+        threading.Lock, threading.RLock, threading.Condition = prev
+        if registry is not None:
+            rec.publish(registry)
+    if ok:
+        cycle = rec.find_cycle()
+        if cycle:
+            raise AssertionError(
+                "lock-order-inversion (runtime): instrumented locks were "
+                f"acquired in a cyclic order {' -> '.join(cycle)}; two "
+                "threads interleaving these edges in opposite orders "
+                f"deadlock. Edges: {rec.order_edges()}"
+            )

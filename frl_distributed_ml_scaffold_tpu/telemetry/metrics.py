@@ -269,27 +269,32 @@ def prometheus_text(registry: MetricsRegistry) -> str:
     ``_bucket{le=...}`` series plus ``_sum``/``_count`` per convention.
     """
     lines: list[str] = []
+    # Render ENTIRELY under the registry lock (like ``snapshot``): the
+    # watchdog/heartbeat threads mutate ``_counts``/``_count``/``_sum``
+    # under it, and rendering after only copying the dict (the previous
+    # shape) could scrape a histogram whose ``_bucket`` rows disagree
+    # with its ``_count`` — a torn read the concurrency lint's guarded-
+    # attribute rule exists to keep out of reports.
     with registry._lock:
-        metrics = dict(registry._metrics)
-    for name in sorted(metrics):
-        m = metrics[name]
-        if m.help:
-            lines.append(f"# HELP {name} {m.help}")
-        if isinstance(m, Counter):
-            lines.append(f"# TYPE {name} counter")
-            lines.append(f"{name} {_fmt(m.value)}")
-        elif isinstance(m, Gauge):
-            lines.append(f"# TYPE {name} gauge")
-            lines.append(f"{name} {_fmt(m.value)}")
-        else:
-            lines.append(f"# TYPE {name} histogram")
-            cum = 0
-            for b, n in zip(m.buckets, m._counts):
-                cum += n
-                lines.append(f'{name}_bucket{{le="{_fmt(b)}"}} {cum}')
-            lines.append(f'{name}_bucket{{le="+Inf"}} {m.count}')
-            lines.append(f"{name}_sum {_fmt(m.sum)}")
-            lines.append(f"{name}_count {m.count}")
+        for name in sorted(registry._metrics):
+            m = registry._metrics[name]
+            if m.help:
+                lines.append(f"# HELP {name} {m.help}")
+            if isinstance(m, Counter):
+                lines.append(f"# TYPE {name} counter")
+                lines.append(f"{name} {_fmt(m.value)}")
+            elif isinstance(m, Gauge):
+                lines.append(f"# TYPE {name} gauge")
+                lines.append(f"{name} {_fmt(m.value)}")
+            else:
+                lines.append(f"# TYPE {name} histogram")
+                cum = 0
+                for b, n in zip(m.buckets, m._counts):
+                    cum += n
+                    lines.append(f'{name}_bucket{{le="{_fmt(b)}"}} {cum}')
+                lines.append(f'{name}_bucket{{le="+Inf"}} {m.count}')
+                lines.append(f"{name}_sum {_fmt(m.sum)}")
+                lines.append(f"{name}_count {m.count}")
     return "\n".join(lines) + "\n"
 
 

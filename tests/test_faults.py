@@ -453,26 +453,35 @@ def test_chaos_non_faulted_requests_token_identical(gpt):
     token-for-token, while every faulted one gets a typed completion.
     ServingConfig knobs drive the engine the way a production config
     would."""
+    from frl_distributed_ml_scaffold_tpu.analysis import pins
+
     model, params = gpt
     scfg = ServingConfig(max_queue_depth=4, default_deadline_s=0.0)
-    eng = ServingEngine(
-        model, params, num_slots=2, temperature=0.0, serving=scfg,
-    )
-    rng = np.random.default_rng(0)
-    reqs = {}
-    poison_rid = 1  # ids are sequential on a fresh engine
-    with faults.active(
-        FaultPlan([dict(site="serve.prefill", key=str(poison_rid), times=0)])
-    ):
-        for i in range(6):
-            prompt = rng.integers(0, 64, size=int(rng.integers(2, 10))).astype(
-                np.int32
+    # The lock-order sentinel (ISSUE 20) rides the chaos headline: every
+    # package lock the engine creates under fault injection is recorded,
+    # and the acquisition order must stay acyclic.
+    with faults.instrumented_locks() as locks_rec:
+        eng = ServingEngine(
+            model, params, num_slots=2, temperature=0.0, serving=scfg,
+        )
+        rng = np.random.default_rng(0)
+        reqs = {}
+        poison_rid = 1  # ids are sequential on a fresh engine
+        with faults.active(
+            FaultPlan(
+                [dict(site="serve.prefill", key=str(poison_rid), times=0)]
             )
-            n_new = int(rng.integers(2, 6))
-            dl = 1e-6 if i == 2 else 0.0  # request 2: instant deadline
-            rid = eng.submit(prompt, n_new, deadline_s=dl)
-            reqs[rid] = (prompt, n_new)
-        done = {c.id: c for c in eng.run()}
+        ):
+            for i in range(6):
+                prompt = rng.integers(
+                    0, 64, size=int(rng.integers(2, 10))
+                ).astype(np.int32)
+                n_new = int(rng.integers(2, 6))
+                dl = 1e-6 if i == 2 else 0.0  # request 2: instant deadline
+                rid = eng.submit(prompt, n_new, deadline_s=dl)
+                reqs[rid] = (prompt, n_new)
+            done = {c.id: c for c in eng.run()}
+    pins.assert_lock_order_acyclic(locks_rec)
     assert sorted(done) == sorted(reqs), "every id resolves exactly once"
     reasons = {rid: done[rid].finish_reason for rid in sorted(done)}
     assert reasons[poison_rid] == "error"
@@ -749,23 +758,30 @@ def test_heartbeat_failures_counted_then_record_retired(tmp_path):
     (heartbeat_write_failures_total) and after N consecutive failures the
     membership record is RETIRED (unlinked, thread stopped) so peers
     evict deterministically instead of racing the staleness window."""
+    from frl_distributed_ml_scaffold_tpu.analysis import pins
     from frl_distributed_ml_scaffold_tpu.launcher.elastic import _Membership
 
-    reg = MetricsRegistry()
-    m = _Membership(str(tmp_path), uid=1, endpoint="h:1", registry=reg)
-    # First beat succeeds (the record exists), then the FS "dies".
-    with faults.active(
-        FaultPlan([dict(site="elastic.heartbeat_write", at=2, times=0)])
-    ):
-        m.start(interval_s=0.02, retire_after=3)
-        assert os.path.exists(m.path)
-        deadline = time.monotonic() + 5
-        while m._thread.is_alive() and time.monotonic() < deadline:
-            time.sleep(0.02)
-    assert not m._thread.is_alive(), "thread should have self-retired"
-    assert not os.path.exists(m.path), "record should be unlinked"
-    assert reg.counter("heartbeat_write_failures_total").value >= 3
-    m.stop()
+    # Sentinel (ISSUE 20): the heartbeat thread's _beat_lock nests over
+    # FaultPlan._lock (maybe_raise) over MetricsRegistry._lock (inc) —
+    # a real three-deep chain that must record acyclic.
+    with faults.instrumented_locks() as locks_rec:
+        reg = MetricsRegistry()
+        m = _Membership(str(tmp_path), uid=1, endpoint="h:1", registry=reg)
+        # First beat succeeds (the record exists), then the FS "dies".
+        with faults.active(
+            FaultPlan([dict(site="elastic.heartbeat_write", at=2, times=0)])
+        ):
+            m.start(interval_s=0.02, retire_after=3)
+            assert os.path.exists(m.path)
+            deadline = time.monotonic() + 5
+            while m._thread.is_alive() and time.monotonic() < deadline:
+                time.sleep(0.02)
+        assert not m._thread.is_alive(), "thread should have self-retired"
+        assert not os.path.exists(m.path), "record should be unlinked"
+        assert reg.counter("heartbeat_write_failures_total").value >= 3
+        m.stop()
+    pins.assert_lock_order_acyclic(locks_rec)
+    pins.assert_no_blocking_under_lock(locks_rec)
 
 
 @pytest.mark.fast
@@ -1037,3 +1053,116 @@ def test_worker_failure_is_rng_neutral_for_sampled_decode(gpt):
     )
     np.testing.assert_array_equal(got_a, ref_a)
     np.testing.assert_array_equal(got_b, ref_b)
+
+
+# ----------------------------------------------- lock-order sentinel
+
+
+@pytest.mark.fast
+def test_instrumented_locks_record_edges_and_raise_on_inversion():
+    """ISSUE 20 runtime sentinel: within ``faults.instrumented_locks``
+    every patched-factory lock records per-thread acquisition order; a
+    clean nesting leaves an acyclic edge set, and acquiring the same two
+    locks in OPPOSITE orders raises AssertionError at scope exit with
+    the cycle named."""
+    import threading
+
+    with faults.instrumented_locks(wrap_all=True) as rec:
+        a = threading.Lock()
+        b = threading.Lock()
+        with a:
+            with b:
+                pass
+    edges = rec.order_edges()
+    assert len(edges) == 1 and next(iter(edges.values())) == 1
+    assert rec.find_cycle() is None
+
+    with pytest.raises(AssertionError, match="lock-order-inversion"):
+        with faults.instrumented_locks(wrap_all=True):
+            a = threading.Lock()
+            b = threading.Lock()
+            with a:
+                with b:
+                    pass
+            with b:
+                with a:
+                    pass
+
+
+@pytest.mark.fast
+def test_instrumented_locks_do_not_mask_body_failures():
+    """A drill's own exception propagates even when the recorder also
+    saw a cycle — the sentinel must never shadow the real failure."""
+    import threading
+
+    with pytest.raises(ValueError, match="the real failure"):
+        with faults.instrumented_locks(wrap_all=True):
+            a, b = threading.Lock(), threading.Lock()
+            with a, b:
+                pass
+            with b, a:
+                pass
+            raise ValueError("the real failure")
+    from frl_distributed_ml_scaffold_tpu.faults import locks as _locks
+
+    assert threading.Lock is _locks._REAL_LOCK  # factories restored
+
+
+@pytest.mark.fast
+def test_instrumented_rlock_reentrancy_and_condition_roundtrip():
+    """RLock reentrancy records ONE acquisition per outermost hold;
+    Condition wait/notify works across threads under instrumentation
+    (wait's release/reacquire is recorded, not deadlocked)."""
+    import threading
+
+    with faults.instrumented_locks(wrap_all=True) as rec:
+        r = threading.RLock()
+        with r:
+            with r:  # re-entry: no second acquisition recorded
+                pass
+        cond = threading.Condition()
+        seen = []
+
+        def consumer():
+            with cond:
+                while not seen:
+                    cond.wait(timeout=5)
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        time.sleep(0.05)
+        with cond:
+            seen.append(1)
+            cond.notify()
+        t.join(5)
+        assert not t.is_alive()
+    total = sum(rec.order_edges().values(), 0)
+    acq = {s for s in rec.max_holds()}
+    assert any("#" in s or ":" in s for s in acq)  # per-instance site ids
+    assert rec.find_cycle() is None
+    assert total >= 0  # edge map well-formed after cross-thread waits
+
+
+@pytest.mark.fast
+def test_instrumented_locks_publish_telemetry_and_pins():
+    """publish(registry) emits the four series; the analysis pins accept
+    a clean recording and reject a held-too-long lock."""
+    import threading
+
+    from frl_distributed_ml_scaffold_tpu.analysis import pins
+
+    reg = MetricsRegistry()
+    with faults.instrumented_locks(registry=reg, wrap_all=True) as rec:
+        a = threading.Lock()
+        b = threading.Lock()
+        with a:
+            with b:
+                time.sleep(0.05)
+    assert reg.counter("lock_acquisitions_total").value >= 2
+    assert reg.gauge("lock_sites").value >= 2
+    assert reg.gauge("lock_order_edges").value >= 1
+    assert reg.gauge("lock_hold_max_seconds").value >= 0.05
+    pins.assert_lock_order_acyclic(rec)
+    pins.assert_no_blocking_under_lock(rec, max_hold_s=2.0)
+    with pytest.raises(AssertionError, match="held"):
+        pins.assert_no_blocking_under_lock(rec, max_hold_s=0.01)

@@ -979,6 +979,206 @@ def test_robustness_repo_package_is_clean():
     assert report.errors() == [], [f.message for f in report.errors()]
 
 
+# ----------------------------------------------------------- concurrency
+
+
+@pytest.mark.fast
+@pytest.mark.chaos
+def test_concurrency_unguarded_shared_write_mutation_gate():
+    """ISSUE 20 mutation gate (a): an attribute written under
+    ``self._lock`` in one method is GUARDED; a read-modify-write of it
+    outside that lock, on a class that spawns threads, is an ERROR
+    (lost-update race). The properly-locked twin lints clean."""
+    from frl_distributed_ml_scaffold_tpu.analysis.concurrency import (
+        lint_concurrency_source,
+    )
+
+    bad = '''
+import threading
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def add(self, n):
+        with self._lock:
+            self._count += n
+
+    def _run(self):
+        self._count += 1  # RMW of a guarded attr, no lock held
+'''
+    findings = lint_concurrency_source(bad, "bad.py")
+    races = [f for f in findings if f.code == "unguarded-shared-write"]
+    assert len(races) == 1, findings
+    assert races[0].severity == "error"
+    assert "_count" in races[0].message
+    assert "Pool._lock" in races[0].message
+
+    clean = bad.replace(
+        "        self._count += 1  # RMW of a guarded attr, no lock held",
+        "        with self._lock:\n            self._count += 1",
+    )
+    assert lint_concurrency_source(clean, "clean.py") == [], (
+        lint_concurrency_source(clean, "clean.py")
+    )
+
+
+@pytest.mark.fast
+@pytest.mark.chaos
+def test_concurrency_lock_order_inversion_mutation_gate():
+    """ISSUE 20 mutation gate (b): both inversion shapes are caught —
+    a direct nested-``with`` A→B/B→A in one module, and the
+    interprocedural form where each class takes its own lock then calls
+    into the other (edges discovered through annotated constructor
+    params). The one-direction variant lints clean."""
+    from frl_distributed_ml_scaffold_tpu.analysis.concurrency import (
+        lint_concurrency_source,
+    )
+
+    direct = '''
+import threading
+
+a = threading.Lock()
+b = threading.Lock()
+
+def fwd():
+    with a:
+        with b:
+            pass
+
+def rev():
+    with b:
+        with a:
+            pass
+'''
+    findings = lint_concurrency_source(direct, "direct.py")
+    cycles = [f for f in findings if f.code == "lock-order-inversion"]
+    assert len(cycles) == 1, findings
+    assert cycles[0].severity == "error"
+    assert "direct.py" in cycles[0].message  # edge sites are named
+
+    interproc = '''
+import threading
+
+class Right:
+    def __init__(self, left: "Left"):
+        self._lock = threading.Lock()
+        self._left = left
+
+    def bump(self):
+        with self._lock:
+            pass
+
+    def rev(self):
+        with self._lock:
+            self._left.poke()
+
+class Left:
+    def __init__(self, right: "Right"):
+        self._lock = threading.Lock()
+        self._right = right
+
+    def poke(self):
+        with self._lock:
+            pass
+
+    def fwd(self):
+        with self._lock:
+            self._right.bump()
+'''
+    findings = lint_concurrency_source(interproc, "interproc.py")
+    cycles = [f for f in findings if f.code == "lock-order-inversion"]
+    assert len(cycles) == 1, findings
+    assert "Left._lock" in cycles[0].message
+    assert "Right._lock" in cycles[0].message
+
+    # Drop one direction and the cycle disappears.
+    one_way = interproc.replace(
+        "    def rev(self):\n        with self._lock:\n"
+        "            self._left.poke()\n",
+        "",
+    )
+    assert one_way != interproc
+    assert lint_concurrency_source(one_way, "one_way.py") == [], (
+        lint_concurrency_source(one_way, "one_way.py")
+    )
+
+
+@pytest.mark.fast
+@pytest.mark.chaos
+def test_concurrency_blocking_under_lock_mutation_gate():
+    """ISSUE 20 mutation gate (c): text-surgery on the REAL
+    ``telemetry/metrics.py`` source — inserting ``jax.block_until_ready``
+    inside ``Counter.inc``'s locked region — trips ``blocking-under-lock``
+    (error), while the committed source stays clean.  Also the
+    interprocedural shape: a helper that sleeps, called under a lock."""
+    from frl_distributed_ml_scaffold_tpu.analysis.concurrency import (
+        lint_concurrency_source,
+    )
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    src = open(
+        os.path.join(
+            repo, "frl_distributed_ml_scaffold_tpu", "telemetry",
+            "metrics.py",
+        )
+    ).read()
+    assert lint_concurrency_source(src, "metrics.py") == [], (
+        lint_concurrency_source(src, "metrics.py")
+    )
+    anchor = "        with self._reg._lock:\n            self._value += n"
+    assert anchor in src
+    mutated = src.replace(
+        anchor,
+        "        with self._reg._lock:\n"
+        "            jax.block_until_ready(n)\n"
+        "            self._value += n",
+    )
+    findings = lint_concurrency_source(mutated, "metrics.py")
+    blocked = [f for f in findings if f.code == "blocking-under-lock"]
+    assert len(blocked) == 1, findings
+    assert blocked[0].severity == "error"
+    assert "block_until_ready" in blocked[0].message
+
+    indirect = '''
+import time
+import threading
+
+class Poller:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def _backoff(self):
+        time.sleep(0.5)
+
+    def step(self):
+        with self._lock:
+            self._backoff()
+'''
+    findings = lint_concurrency_source(indirect, "indirect.py")
+    blocked = [f for f in findings if f.code == "blocking-under-lock"]
+    assert blocked and all(f.severity == "error" for f in blocked), findings
+    assert any("time.sleep" in f.message for f in blocked)
+
+
+@pytest.mark.fast
+@pytest.mark.chaos
+def test_concurrency_repo_package_is_clean():
+    """The whole package (serving engine, elastic launcher, telemetry,
+    native loader) carries no lock-discipline errors: every guarded
+    attribute is written under its lock, the acquisition-order graph is
+    acyclic, and nothing blocks while holding a lock."""
+    from frl_distributed_ml_scaffold_tpu.analysis.runner import (
+        lint_concurrency,
+    )
+
+    report = lint_concurrency()
+    assert report.meta["files"] > 50  # the glob really covers the package
+    assert report.errors() == [], [f.message for f in report.errors()]
+
+
 # ------------------------------------------------------------ runner/CLI
 
 
@@ -1094,9 +1294,67 @@ def test_cli_all_recipes_runs_clean_and_emits_json(tmp_path):
     assert "reshard:restore_even_to_fsdp" in programs
     assert "hygiene:traced-modules" in programs
     assert "robustness:package" in programs
+    assert "concurrency:package" in programs
     assert all(r["ok"] for r in reports), [
         r["program"] for r in reports if not r["ok"]
     ]
+
+
+@pytest.mark.fast
+def test_cli_only_selects_named_pass_families(tmp_path):
+    """ISSUE 20 satellite: ``--only concurrency`` runs exactly that pass
+    (no recipe tracing — fast), exits 0 on HEAD, and stacking ``--only``
+    flags unions the families."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = tmp_path / "only.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "graft_lint.py"),
+         "--only", "concurrency", "--json", str(out), "-q"],
+        capture_output=True, text=True, env=env, cwd=repo, timeout=540,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    reports = json.loads(out.read_text())
+    assert {r["program"] for r in reports} == {"concurrency:package"}
+
+    out3 = tmp_path / "only3.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "graft_lint.py"),
+         "--only", "concurrency", "--only", "robustness",
+         "--only", "hygiene", "--json", str(out3), "-q"],
+        capture_output=True, text=True, env=env, cwd=repo, timeout=540,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    programs = {r["program"] for r in json.loads(out3.read_text())}
+    assert programs == {
+        "concurrency:package", "robustness:package",
+        "hygiene:traced-modules",
+    }
+
+
+@pytest.mark.fast
+def test_cli_only_unknown_pass_refused(tmp_path):
+    """A typo'd pass name must fail loudly (argparse choices), not lint
+    nothing and exit 0; --only also refuses to combine with --no-*."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "graft_lint.py"),
+         "--only", "concurency", "-q"],  # sic: typo'd
+        capture_output=True, text=True, env=env, cwd=repo, timeout=120,
+    )
+    assert proc.returncode != 0
+    assert "invalid choice" in proc.stderr, proc.stderr
+
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "graft_lint.py"),
+         "--only", "hygiene", "--no-serving", "-q"],
+        capture_output=True, text=True, env=env, cwd=repo, timeout=120,
+    )
+    assert proc.returncode != 0
+    assert "--no-" in proc.stderr, proc.stderr
 
 
 @pytest.mark.fast

@@ -274,3 +274,57 @@ def test_prefetch_transfers_on_worker_thread():
             )[key], key
     finally:
         pre.close()
+
+
+def test_native_load_builds_lock_free_and_racers_park_on_done(monkeypatch):
+    """Regression for graft-lint concurrency finding blocking-under-lock
+    (data/native.py _load -> _build -> subprocess.run): the module lock
+    only claims/publishes — the slow build runs LOCK-FREE, so mid-build
+    the lock is immediately available and a racing caller parks on
+    ``_done`` (returning the published lib) instead of queueing behind a
+    120 s compile."""
+    import threading
+
+    monkeypatch.setattr(nv, "_lib", None)
+    monkeypatch.setattr(nv, "_tried", False)
+    monkeypatch.setattr(nv, "_done", threading.Event())
+
+    in_build = threading.Event()
+    release = threading.Event()
+    sentinel = object()  # stands in for the CDLL
+
+    def fake_uncached():
+        assert nv._lock.acquire(blocking=False), (
+            "_load holds native._lock across the build again"
+        )
+        nv._lock.release()
+        in_build.set()
+        assert release.wait(5)
+        return sentinel
+
+    monkeypatch.setattr(nv, "_load_uncached", fake_uncached)
+
+    got = {}
+    t1 = threading.Thread(target=lambda: got.__setitem__("a", nv._load()))
+    t1.start()
+    assert in_build.wait(5)
+    t2 = threading.Thread(target=lambda: got.__setitem__("b", nv._load()))
+    t2.start()
+    t2.join(0.2)
+    assert t2.is_alive(), "racer should park on _done, not claim a build"
+    release.set()
+    t1.join(5)
+    t2.join(5)
+    assert got["a"] is sentinel and got["b"] is sentinel
+
+
+def test_native_module_carries_no_concurrency_findings():
+    """The static side of the same regression: the concurrency pass on
+    data/native.py stays empty (no blocking-under-lock on the build
+    path, no unguarded writes to the _lib/_tried publication state)."""
+    from frl_distributed_ml_scaffold_tpu.analysis.concurrency import (
+        lint_concurrency_paths,
+    )
+
+    findings = lint_concurrency_paths([nv.__file__])
+    assert findings == [], [f.message for f in findings]

@@ -598,28 +598,36 @@ def test_respread_pool_inflight_token_identity(gpt):
     ref = {c.id: c for c in ref_eng.run()}
     ref_eng.close()
 
-    env2 = _mesh(devices=jax.devices()[:2], data=1, model=2)
-    with mesh_context(env2):
-        sp = shard_params_for_serving(params, env2, gpt_tp_rules())
-        eng = ServingEngine(
-            model, sp, num_slots=2, temperature=0.0, kv_block_size=8
+    # Lock-order sentinel (ISSUE 20): the live re-spread (park, move,
+    # resume) runs under lock instrumentation — the acquisition order
+    # across the engine + redistribute executor must stay acyclic.
+    from frl_distributed_ml_scaffold_tpu import faults
+    from frl_distributed_ml_scaffold_tpu.analysis import pins
+
+    with faults.instrumented_locks() as locks_rec:
+        env2 = _mesh(devices=jax.devices()[:2], data=1, model=2)
+        with mesh_context(env2):
+            sp = shard_params_for_serving(params, env2, gpt_tp_rules())
+            eng = ServingEngine(
+                model, sp, num_slots=2, temperature=0.0, kv_block_size=8
+            )
+            ids = [eng.submit(p, 8) for p in prompts]
+            eng.step()
+            eng.step()
+        env4 = _mesh(devices=jax.devices()[:4], data=1, model=4)
+        plans = eng.respread_pool(env4)
+        assert eng.stats["parked"] == 2 and eng.stats["resumed"] == 2
+        assert plans["cache"].bytes_moved > 0
+        assert (
+            plans["cache"].executed_scratch_bytes
+            <= plans["cache"].peak_scratch_bytes
         )
-        ids = [eng.submit(p, 8) for p in prompts]
-        eng.step()
-        eng.step()
-    env4 = _mesh(devices=jax.devices()[:4], data=1, model=4)
-    plans = eng.respread_pool(env4)
-    assert eng.stats["parked"] == 2 and eng.stats["resumed"] == 2
-    assert plans["cache"].bytes_moved > 0
-    assert (
-        plans["cache"].executed_scratch_bytes
-        <= plans["cache"].peak_scratch_bytes
-    )
-    snap = eng.telemetry.snapshot()
-    assert snap["serve_pool_respread_total"] == 1
-    assert snap["serve_pool_respread_bytes_total"] > 0
-    done = {c.id: c for c in eng.run()}
-    eng.close()
+        snap = eng.telemetry.snapshot()
+        assert snap["serve_pool_respread_total"] == 1
+        assert snap["serve_pool_respread_bytes_total"] > 0
+        done = {c.id: c for c in eng.run()}
+        eng.close()
+    pins.assert_lock_order_acyclic(locks_rec)
     for rid, want in zip(ids, rids):
         np.testing.assert_array_equal(done[rid].tokens, ref[want].tokens)
 

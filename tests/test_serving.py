@@ -1444,20 +1444,27 @@ def test_disagg_preemption_park_resume_token_identity(gpt):
     zero device work), decodes to completion, and the parked request
     RESUMES (table re-own + one cursor pointer-move) and finishes
     TOKEN-IDENTICALLY — nothing about its K/V ever moved."""
+    from frl_distributed_ml_scaffold_tpu import faults
+
     model, params, _ = gpt
-    eng = DisaggServingEngine(
-        model, params, num_slots=1, temperature=0.0, kv_block_size=8,
-        tenants=[TenantSpec("fg", "latency"),
-                 TenantSpec("bg", "best_effort")],
-    )
-    pb = np.arange(4, dtype=np.int32)
-    pf = (np.arange(5, dtype=np.int32) + 7) % 64
-    rb = eng.submit(pb, 14, tenant="bg")
-    out = []
-    for _ in range(4):  # bg decoding mid-stream when fg arrives
-        out += eng.step()
-    rf = eng.submit(pf, 4, tenant="fg")
-    done = {c.id: c for c in out + eng.run()}
+    # Lock-order sentinel (ISSUE 20): the disagg engine's worker queues
+    # and telemetry locks record under instrumentation — park/resume
+    # must not introduce a cyclic acquisition order.
+    with faults.instrumented_locks() as locks_rec:
+        eng = DisaggServingEngine(
+            model, params, num_slots=1, temperature=0.0, kv_block_size=8,
+            tenants=[TenantSpec("fg", "latency"),
+                     TenantSpec("bg", "best_effort")],
+        )
+        pb = np.arange(4, dtype=np.int32)
+        pf = (np.arange(5, dtype=np.int32) + 7) % 64
+        rb = eng.submit(pb, 14, tenant="bg")
+        out = []
+        for _ in range(4):  # bg decoding mid-stream when fg arrives
+            out += eng.step()
+        rf = eng.submit(pf, 4, tenant="fg")
+        done = {c.id: c for c in out + eng.run()}
+    pins.assert_lock_order_acyclic(locks_rec)
     assert eng.stats["preemptions"] == 1
     assert eng.stats["parked"] == 1 and eng.stats["resumed"] == 1
     assert eng.telemetry.counter("serve_preemption_total").value == 1

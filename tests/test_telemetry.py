@@ -133,6 +133,47 @@ def test_prometheus_text_matches_golden():
 
 
 @pytest.mark.fast
+def test_prometheus_text_never_tears_under_concurrent_observes():
+    """Regression for the graft-lint concurrency audit of
+    telemetry/metrics.py: ``prometheus_text`` renders ENTIRELY under the
+    registry lock. The previous shape copied the metrics dict under the
+    lock but read ``_counts``/``count``/``sum`` outside it, so a scrape
+    racing ``observe()`` could publish a histogram whose bucket rows
+    disagree with ``_count``/``_sum``. Every observation here adds
+    exactly 1.0, so any torn render shows ``sum != count`` or an +Inf
+    cumulative != count."""
+    import threading
+
+    reg = MetricsRegistry()
+    h = reg.histogram("tear_check_seconds", buckets=(0.5, 2.0))
+    stop = threading.Event()
+
+    def writer():
+        while not stop.is_set():
+            h.observe(1.0)
+
+    t = threading.Thread(target=writer, daemon=True)
+    t.start()
+    try:
+        deadline = time.monotonic() + 1.0
+        renders = 0
+        while time.monotonic() < deadline:
+            rows = dict(
+                line.rsplit(" ", 1)
+                for line in prometheus_text(reg).strip().splitlines()
+                if not line.startswith("#")
+            )
+            count = int(rows["tear_check_seconds_count"])
+            assert float(rows["tear_check_seconds_sum"]) == float(count)
+            assert int(rows['tear_check_seconds_bucket{le="+Inf"}']) == count
+            renders += 1
+    finally:
+        stop.set()
+        t.join(5)
+    assert renders > 50 and h.count > 0  # the race was actually exercised
+
+
+@pytest.mark.fast
 def test_snapshot_jsonl_roundtrip_and_prom_file(tmp_path):
     """snapshot() survives a JSONL round trip with the raw bucket counts
     intact (the telemetry_report merge contract), and the .prom sidecar

@@ -89,6 +89,13 @@ def _record_from_dict(d):
     )
 
 
+#: The pass families --only selects from (argparse refuses anything
+#: else — a typo'd pass name must fail loudly, not lint nothing).
+_FAMILIES = (
+    "recipes", "serving", "reshard", "hygiene", "robustness", "concurrency",
+)
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument(
@@ -117,6 +124,18 @@ def main(argv: list[str] | None = None) -> int:
         help="skip the failure-semantics robustness lint",
     )
     ap.add_argument(
+        "--no-concurrency", action="store_true",
+        help="skip the lock-discipline concurrency lint",
+    )
+    ap.add_argument(
+        "--only", action="append", default=[], metavar="PASS",
+        choices=sorted(_FAMILIES),
+        help="run ONLY the named pass families (repeatable; one of: "
+        + ", ".join(sorted(_FAMILIES))
+        + "). Unknown names are refused. 'recipes' still needs "
+        "--all-recipes or --recipe.",
+    )
+    ap.add_argument(
         "--budget-mb", type=float, default=None,
         help="materialization budget per intermediate, in MiB (error "
         "above; default: census only)",
@@ -137,8 +156,22 @@ def main(argv: list[str] | None = None) -> int:
         help="only print failing programs and the final summary",
     )
     args = ap.parse_args(argv)
-    if not args.all_recipes and not args.recipe:
+    only = set(args.only)
+    if only:
+        if (
+            args.no_serving or args.no_reshard or args.no_hygiene
+            or args.no_robustness or args.no_concurrency
+        ):
+            ap.error("--only cannot be combined with --no-* flags")
+        if "recipes" in only and not (args.all_recipes or args.recipe):
+            ap.error("--only recipes needs --all-recipes or --recipe NAME")
+    elif not args.all_recipes and not args.recipe:
         ap.error("pass --all-recipes or at least one --recipe NAME")
+
+    run_recipes = "recipes" in only if only else True
+
+    def _family(name: str, no_flag: bool) -> bool:
+        return (name in only) if only else not no_flag
 
     from frl_distributed_ml_scaffold_tpu.analysis.runner import lint_all
 
@@ -154,11 +187,16 @@ def main(argv: list[str] | None = None) -> int:
                 print(line, flush=True)
 
     reports = lint_all(
-        recipes=None if args.all_recipes else args.recipe,
-        serving=not args.no_serving,
-        reshard=not args.no_reshard,
-        hygiene=not args.no_hygiene,
-        robustness=not args.no_robustness,
+        recipes=(
+            (None if args.all_recipes else args.recipe)
+            if run_recipes
+            else []
+        ),
+        serving=_family("serving", args.no_serving),
+        reshard=_family("reshard", args.no_reshard),
+        hygiene=_family("hygiene", args.no_hygiene),
+        robustness=_family("robustness", args.no_robustness),
+        concurrency=_family("concurrency", args.no_concurrency),
         workdir=args.workdir,
         budget_bytes=budget,
         on_report=progress if args.against is None else None,
